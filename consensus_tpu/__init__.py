@@ -1,0 +1,18 @@
+"""consensus_tpu — a TPU-native distributed-consensus simulation framework.
+
+Re-designed from scratch with the capabilities of ``2892931976/consensus-rs``
+(see SURVEY.md; the reference mount was empty, so parity targets come from
+BASELINE.json and the public protocol specs): Raft, PBFT, multi-decree
+Paxos, and DPoS engines behind one engine seam, driven by a round-based
+simulator with seeded adversarial fault injection, plus a C++ scalar
+oracle for decided-log byte-equivalence.
+
+TPU-first design: the whole node population's state is a struct-of-arrays
+pytree; each protocol round is a pure branchless jnp kernel; rounds advance
+under ``lax.scan``; sweeps are batch axes; quorum tallies ``psum`` across a
+``shard_map`` device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from .core.config import Config  # noqa: F401

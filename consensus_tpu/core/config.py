@@ -1,0 +1,106 @@
+"""One Config schema shared by the TPU engine, the C++ oracle, and the CLI.
+
+Mirrors the reference's CLI→Config→Simulator flow (SURVEY.md §1, [B:5]).
+All probabilities are converted once, on the host, to integer u32 cutoffs
+(:func:`consensus_tpu.core.rng.prob_threshold_u32`) so that the JAX engine
+and the C++ oracle compare raw threefry draws against the *same integers* —
+float rounding can never make the engines diverge.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+from .rng import prob_threshold_u32
+
+PROTOCOLS = ("raft", "pbft", "paxos", "dpos")
+ENGINES = ("cpu", "tpu")
+
+
+@dataclass(frozen=True)
+class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
+    protocol: str = "raft"
+    engine: str = "tpu"
+
+    # Population / schedule. For pbft, n_nodes must equal 3f+1.
+    n_nodes: int = 5
+    n_rounds: int = 64
+    n_sweeps: int = 1          # independent simulator instances (batch axis)
+    seed: int = 0
+
+    # Log / slot shape (fixed shapes for XLA; SURVEY.md §7 "hard parts").
+    log_capacity: int = 128    # raft log length L / pbft+paxos slot count S
+    max_entries: int = 100     # raft: client entries a leader may propose
+
+    # Raft election timeouts, in rounds (randomized per (term, node)).
+    t_min: int = 3
+    t_max: int = 8
+
+    # Adversary rates (converted to u32 cutoffs below).
+    drop_rate: float = 0.0       # per (round, directed edge) message drop
+    partition_rate: float = 0.0  # per round: bipartition active?
+    churn_rate: float = 0.0      # per round: all leaders forced to step down
+
+    # PBFT.
+    f: int = 1                   # byzantine tolerance; n_nodes = 3f+1
+    view_timeout: int = 8        # rounds without progress before view change
+
+    # Paxos.
+    n_proposers: int = 0         # 0 ⇒ all nodes propose
+
+    # DPoS.
+    n_candidates: int = 16
+    n_producers: int = 4         # K active producers per epoch
+    epoch_len: int = 16          # rounds per epoch
+
+    # Parallelism (TPU engine only; ignored by the oracle).
+    mesh_shape: tuple = ()       # e.g. (8,) to shard sweeps/nodes over 8 chips
+    scan_chunk: int = 0          # 0 ⇒ single scan; else blocked scan chunk size
+
+    def __post_init__(self) -> None:
+        if self.protocol not in PROTOCOLS:
+            raise ValueError(f"unknown protocol {self.protocol!r}")
+        if self.engine not in ENGINES:
+            raise ValueError(f"unknown engine {self.engine!r}")
+        if min(self.n_nodes, self.n_rounds, self.n_sweeps, self.log_capacity) < 1:
+            raise ValueError("n_nodes, n_rounds, n_sweeps, log_capacity must be >= 1")
+        if self.protocol == "pbft":
+            expect = 3 * self.f + 1
+            if self.n_nodes != expect:
+                raise ValueError(
+                    f"pbft requires n_nodes == 3f+1 == {expect}, got {self.n_nodes}")
+        if self.t_max <= self.t_min:
+            raise ValueError("t_max must exceed t_min")
+
+    # Integer cutoffs — THE values both engines compare draws against.
+    @property
+    def drop_cutoff(self) -> int:
+        return prob_threshold_u32(self.drop_rate)
+
+    @property
+    def partition_cutoff(self) -> int:
+        return prob_threshold_u32(self.partition_rate)
+
+    @property
+    def churn_cutoff(self) -> int:
+        return prob_threshold_u32(self.churn_rate)
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["mesh_shape"] = list(self.mesh_shape)
+        d["_cutoffs"] = {  # informational; re-derived on load
+            "drop": self.drop_cutoff,
+            "partition": self.partition_cutoff,
+            "churn": self.churn_cutoff,
+        }
+        return json.dumps(d, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        d: dict[str, Any] = json.loads(s)
+        d.pop("_cutoffs", None)
+        d["mesh_shape"] = tuple(d.get("mesh_shape", ()))
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})

@@ -1,0 +1,158 @@
+"""Counter-based RNG shared bit-exactly by the JAX engine and the C++ oracle.
+
+The reference (`2892931976/consensus-rs`, see SURVEY.md §0 — mount was empty,
+reconstructed from BASELINE.json:5) drives its adversary (partitions, drops,
+leader churn) and randomized election timeouts from a seeded RNG. For
+decided-log byte-equivalence between the TPU engine and the CPU oracle
+(BASELINE.json:2), both sides must draw *identical* random streams with
+*no shared iteration order*. A counter-based generator is the only sane
+choice: random value = pure function of (seed, stream, round, index).
+
+We implement Threefry-2x32 (Salmon et al., SC'11 "Parallel Random Numbers:
+As Easy as 1, 2, 3") with the standard 20-round schedule — the same
+algorithm JAX uses internally — in three places:
+
+  * here in vectorized numpy (host-side precompute, tests),
+  * here in jnp (device-side, traceable under jit/vmap/scan),
+  * in ``cpp/oracle.cpp`` (scalar, for the C++ oracle).
+
+All three are validated against each other and against
+``jax._src.prng.threefry_2x32`` in ``tests/test_rng.py``.
+
+Stream discipline
+-----------------
+Every random decision in the simulator is drawn as
+
+    bits = threefry2x32(key=(seed ^ STREAM_C, ctx), ctr=(hi, lo))
+
+where STREAM_C is a per-purpose constant (delivery, timeout, churn, ...),
+``ctx`` is a contextual 32-bit value (round or term), and (hi, lo) is a
+64-bit index split into two u32 words. Probability thresholds are integer
+u32 cutoffs precomputed once in :mod:`consensus_tpu.core.config` so no
+float rounding can diverge between engines.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# Threefry-2x32 constants (Random123 reference implementation).
+_KS_PARITY = np.uint32(0x1BD11BDA)
+# Rotation schedule: 4 rounds of R_A interleaved with 4 rounds of R_B, x5.
+_ROT_A = (13, 15, 26, 6)
+_ROT_B = (17, 29, 16, 24)
+
+# Stream constants. Arbitrary odd 32-bit values; must match cpp/oracle.cpp.
+STREAM_DELIVER = np.uint32(0x9E3779B1)  # per (round, edge) message delivery
+STREAM_TIMEOUT = np.uint32(0x85EBCA77)  # per (term, node) election timeout
+STREAM_CHURN = np.uint32(0xC2B2AE3D)    # per round leader-churn event
+STREAM_PARTITION = np.uint32(0x27D4EB2F)  # per round partition side/active
+STREAM_STAKE = np.uint32(0x165667B1)    # per validator initial stake (DPoS)
+STREAM_VOTE = np.uint32(0xD3A2646C)     # per (epoch, validator) vote target
+STREAM_VALUE = np.uint32(0xFD7046C5)    # proposal payload values
+STREAM_BYZANTINE = np.uint32(0xB55A4F09)  # per-config byzantine node pick
+
+
+def _rotl32_np(x: np.ndarray, r: int) -> np.ndarray:
+    x = x.astype(np.uint32, copy=False)
+    return ((x << np.uint32(r)) | (x >> np.uint32(32 - r))).astype(np.uint32)
+
+
+def threefry2x32_np(k0, k1, c0, c1):
+    """Threefry-2x32, 20 rounds. All args uint32 scalars or arrays.
+
+    Returns ``(y0, y1)`` uint32 arrays, broadcast over inputs.
+    """
+    with np.errstate(over="ignore"):  # uint32 wraparound is the algorithm
+        k0 = np.asarray(k0, dtype=np.uint32)
+        k1 = np.asarray(k1, dtype=np.uint32)
+        x0 = np.asarray(c0, dtype=np.uint32).copy()
+        x1 = np.asarray(c1, dtype=np.uint32).copy()
+        x0, x1, k0, k1 = np.broadcast_arrays(x0, x1, k0, k1)
+        x0, x1 = x0.astype(np.uint32).copy(), x1.astype(np.uint32).copy()
+
+        ks0, ks1 = k0, k1
+        ks2 = (ks0 ^ ks1 ^ _KS_PARITY).astype(np.uint32)
+
+        x0 = (x0 + ks0).astype(np.uint32)
+        x1 = (x1 + ks1).astype(np.uint32)
+
+        ks = (ks0, ks1, ks2)
+        for block in range(5):
+            rots = _ROT_A if block % 2 == 0 else _ROT_B
+            for r in rots:
+                x0 = (x0 + x1).astype(np.uint32)
+                x1 = _rotl32_np(x1, r) ^ x0
+            x0 = (x0 + ks[(block + 1) % 3]).astype(np.uint32)
+            x1 = (x1 + ks[(block + 2) % 3] + np.uint32(block + 1)).astype(np.uint32)
+        return x0, x1
+
+
+def random_u32_np(seed: int, stream: np.uint32, ctx, c0, c1):
+    """Draw uint32 words: key=(lo32(seed)^stream, ctx), ctr=(c0, c1).
+
+    ``ctx``, ``c0``, ``c1`` (uint32) may be arrays; broadcasts. Returns the
+    first output word y0. See docs/SPEC.md §1 for the stream table.
+    """
+    k0 = np.uint32(np.uint64(seed) & np.uint64(0xFFFFFFFF)) ^ np.uint32(stream)
+    k1 = np.asarray(ctx, dtype=np.uint32)
+    y0, _ = threefry2x32_np(k0, k1, np.asarray(c0, np.uint32), np.asarray(c1, np.uint32))
+    return y0
+
+
+# --- jnp twin ---------------------------------------------------------------
+
+import jax.numpy as jnp
+
+
+def _rotl32_jnp(x, r: int):
+    return (jnp.left_shift(x, np.uint32(r)) | jnp.right_shift(x, np.uint32(32 - r)))
+
+
+def threefry2x32_jnp(k0, k1, c0, c1):
+    """Traceable twin of :func:`threefry2x32_np`. uint32 in/out."""
+    k0 = jnp.asarray(k0, dtype=jnp.uint32)
+    k1 = jnp.asarray(k1, dtype=jnp.uint32)
+    x0 = jnp.asarray(c0, dtype=jnp.uint32)
+    x1 = jnp.asarray(c1, dtype=jnp.uint32)
+
+    ks0, ks1 = k0, k1
+    ks2 = ks0 ^ ks1 ^ jnp.uint32(_KS_PARITY)
+
+    x0 = x0 + ks0
+    x1 = x1 + ks1
+
+    ks = (ks0, ks1, ks2)
+    for block in range(5):
+        rots = _ROT_A if block % 2 == 0 else _ROT_B
+        for r in rots:
+            x0 = x0 + x1
+            x1 = _rotl32_jnp(x1, r) ^ x0
+        x0 = x0 + ks[(block + 1) % 3]
+        x1 = x1 + ks[(block + 2) % 3] + jnp.uint32(block + 1)
+    return x0, x1
+
+
+def random_u32_jnp(seed, stream, ctx, c0, c1):
+    """Traceable twin of :func:`random_u32_np`. ``seed`` may be a traced
+    uint32 array (per-sweep seeds under vmap); ctx/c0/c1 broadcast."""
+    seed32 = jnp.asarray(seed).astype(jnp.uint32)
+    k0 = seed32 ^ jnp.uint32(int(np.uint32(stream)))
+    k1 = jnp.asarray(ctx, dtype=jnp.uint32)
+    y0, _ = threefry2x32_jnp(k0, k1, jnp.asarray(c0, jnp.uint32), jnp.asarray(c1, jnp.uint32))
+    return y0
+
+
+def prob_threshold_u32(p: float) -> int:
+    """Integer cutoff for probability ``p``: draw < cutoff ⇔ event fires.
+
+    Computed once on the host; both engines compare raw u32 draws against
+    this integer, so no float ever enters the hot path.
+    """
+    if p <= 0.0:
+        return 0
+    if p >= 1.0:
+        # u32 comparison is strict `draw < cutoff`; 0xFFFFFFFF fires with
+        # probability 1 - 2^-32. Both engines use the identical comparison,
+        # so cross-engine agreement is exact regardless.
+        return 0xFFFFFFFF
+    return min(int(p * 4294967296.0), 0xFFFFFFFF)

@@ -1,0 +1,86 @@
+"""Canonical decided-log serialization — the byte-equivalence contract.
+
+The acceptance test of the whole framework is *byte*-equivalence of decided
+logs between the TPU engine and the C++ oracle (BASELINE.json:2,5;
+SURVEY.md §4.3). Both sides therefore serialize through one fixed spec:
+
+    header:  magic "CTPU" | version u8=1 | protocol u8 | n_sweeps u32 | n_nodes u32
+    body:    for sweep b in 0..n_sweeps:        (row-major, little-endian)
+               for node n in 0..n_nodes:
+                 count u32
+                 count × record { a u32, b u32 }
+
+Record meaning per protocol (a, b):
+    raft : (term of committed entry, entry value)     — in log order, k < commit
+    pbft : (slot index, decided value)                — decided slots, ascending
+    paxos: (slot index, learned value)                — learned slots, ascending
+    dpos : (round index, producer id of chain block)  — in chain order
+
+The C++ oracle (cpp/oracle.cpp) emits the identical layout; equality is
+checked on raw bytes and reported as a SHA-256 digest (O(1) to compare,
+SURVEY.md §5 "metrics").
+"""
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+
+MAGIC = b"CTPU"
+VERSION = 1
+PROTOCOL_IDS = {"raft": 0, "pbft": 1, "paxos": 2, "dpos": 3}
+
+
+def serialize_decided(protocol: str, counts: np.ndarray,
+                      rec_a: np.ndarray, rec_b: np.ndarray) -> bytes:
+    """Serialize per-(sweep, node) decided logs.
+
+    counts: [B, N] int — number of records for each node.
+    rec_a, rec_b: [B, N, L] int — record fields; only the first counts[b, n]
+    entries of each row are meaningful.
+    """
+    counts = np.asarray(counts)
+    rec_a = np.asarray(rec_a)
+    rec_b = np.asarray(rec_b)
+    if counts.ndim != 2 or rec_a.ndim != 3 or rec_b.ndim != 3:
+        raise ValueError("counts must be [B,N]; records [B,N,L]")
+    B, N = counts.shape
+    out = bytearray()
+    out += MAGIC
+    out += struct.pack("<BBII", VERSION, PROTOCOL_IDS[protocol], B, N)
+    ca = counts.astype(np.int64)
+    a32 = rec_a.astype(np.uint32)
+    b32 = rec_b.astype(np.uint32)
+    for b in range(B):
+        for n in range(N):
+            c = int(ca[b, n])
+            out += struct.pack("<I", c)
+            if c:
+                inter = np.empty(2 * c, dtype=np.uint32)
+                inter[0::2] = a32[b, n, :c]
+                inter[1::2] = b32[b, n, :c]
+                out += inter.tobytes()  # numpy is little-endian on all targets here
+    return bytes(out)
+
+
+def pack_sparse(mask: np.ndarray, vals: np.ndarray):
+    """Turn dense decided arrays [B, N, S] into (counts, slots, vals) with
+    slots ascending — the canonical order for pbft/paxos records."""
+    mask = np.asarray(mask, dtype=bool)
+    vals = np.asarray(vals)
+    B, N, S = mask.shape
+    counts = mask.sum(axis=2).astype(np.uint32)
+    L = int(counts.max()) if counts.size else 0
+    slots = np.zeros((B, N, max(L, 1)), dtype=np.uint32)
+    out_vals = np.zeros((B, N, max(L, 1)), dtype=np.uint32)
+    for b in range(B):
+        for n in range(N):
+            idx = np.nonzero(mask[b, n])[0]
+            slots[b, n, : idx.size] = idx
+            out_vals[b, n, : idx.size] = vals[b, n, idx]
+    return counts, slots, out_vals
+
+
+def digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()

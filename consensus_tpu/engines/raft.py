@@ -1,0 +1,298 @@
+"""Raft as a branchless JAX array kernel — the TPU engine's flagship.
+
+Implements docs/SPEC.md §3 over the whole node population at once: state is
+a struct-of-arrays pytree (one row per node), one round is a pure function
+built from masked `where`-selects and matrix-shaped message exchanges, and
+a run is `lax.scan` over rounds with sweeps vmapped as a leading batch axis
+(SURVEY.md §7 core design decision; the reference's `raft::log` scalar hot
+loops `match_index`/`append_entries` [B:5] become the gather/scatter and
+running-max updates below).
+
+Everything is int32 on device (TPU x64 is disabled); u32 semantics from
+the spec are preserved because terms/indices stay < 2^31 and RNG words are
+bitcast — byte-equivalence with the uint32 C++ oracle is checked in
+tests/test_raft_differential.py.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.config import Config
+
+ROLE_F, ROLE_C, ROLE_L = 0, 1, 2
+NONE = -1
+
+
+class RaftState(NamedTuple):
+    seed: jnp.ndarray       # [] uint32 — per-sweep seed (SPEC §1)
+    term: jnp.ndarray       # [N] i32
+    role: jnp.ndarray       # [N] i32
+    voted_for: jnp.ndarray  # [N] i32
+    log_term: jnp.ndarray   # [N, L] i32
+    log_val: jnp.ndarray    # [N, L] i32
+    log_len: jnp.ndarray    # [N] i32
+    commit: jnp.ndarray     # [N] i32
+    timer: jnp.ndarray      # [N] i32
+    timeout: jnp.ndarray    # [N] i32
+    match_idx: jnp.ndarray  # [N, N] i32 — match_idx[l, j]
+    next_idx: jnp.ndarray   # [N, N] i32
+
+
+def _draw(seed, stream, ctx, c0, c1):
+    return rng.random_u32_jnp(seed, stream, ctx, c0, c1)
+
+
+def _lt(cut: int):
+    """u32 cutoff as a jnp constant."""
+    return jnp.uint32(cut)
+
+
+def _i32(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _draw_timeout(seed, t_min, t_max, term, idx):
+    d = _draw(seed, rng.STREAM_TIMEOUT, term.astype(jnp.uint32), 0, idx)
+    return jnp.int32(t_min) + (d % jnp.uint32(t_max - t_min)).astype(jnp.int32)
+
+
+def raft_init(cfg: Config, seed) -> RaftState:
+    N, L = cfg.n_nodes, cfg.log_capacity
+    seed = jnp.asarray(seed, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    z = jnp.zeros(N, jnp.int32)
+    return RaftState(
+        seed=seed,
+        term=z, role=z, voted_for=jnp.full(N, NONE, jnp.int32),
+        log_term=jnp.zeros((N, L), jnp.int32),
+        log_val=jnp.zeros((N, L), jnp.int32),
+        log_len=z, commit=z, timer=z,
+        timeout=_draw_timeout(seed, cfg.t_min, cfg.t_max, z, idx.astype(jnp.uint32)),
+        match_idx=jnp.zeros((N, N), jnp.int32),
+        next_idx=jnp.ones((N, N), jnp.int32),
+    )
+
+
+def _delivery(seed, N: int, r, drop_cut: int, part_cut: int):
+    """SPEC §2: [i, j] True iff a message i→j is delivered in round r."""
+    i = jnp.arange(N, dtype=jnp.uint32)[:, None]
+    j = jnp.arange(N, dtype=jnp.uint32)[None, :]
+    dropped = _draw(seed, rng.STREAM_DELIVER, r, i, j) < _lt(drop_cut)
+    part_active = _draw(seed, rng.STREAM_PARTITION, r, 0, 0) < _lt(part_cut)
+    side = (_draw(seed, rng.STREAM_PARTITION, r, 1, jnp.arange(N, dtype=jnp.uint32))
+            & jnp.uint32(1))
+    same_side = side[:, None] == side[None, :]
+    off_diag = i != j
+    return (~dropped) & (same_side | ~part_active) & off_diag
+
+
+def _last_term(log_term, log_len):
+    """log_term[i, log_len[i]-1] or 0 for empty logs."""
+    L = log_term.shape[-1]
+    k = jnp.clip(log_len - 1, 0, L - 1)
+    v = jnp.take_along_axis(log_term, k[:, None], axis=1)[:, 0]
+    return jnp.where(log_len > 0, v, 0)
+
+
+def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
+    """One SPEC §3 round. `cfg` static; `r` traced i32 scalar."""
+    N, L = cfg.n_nodes, cfg.log_capacity
+    E = min(cfg.max_entries, L)
+    majority = N // 2 + 1
+    seed = st.seed
+    idx = jnp.arange(N, dtype=jnp.int32)
+    uidx = idx.astype(jnp.uint32)
+    ur = jnp.asarray(r, jnp.uint32)
+    eye = jnp.eye(N, dtype=bool)
+
+    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+
+    term, role, voted_for = st.term, st.role, st.voted_for
+    log_term, log_val, log_len = st.log_term, st.log_val, st.log_len
+    commit, timer, timeout = st.commit, st.timer, st.timeout
+    match_idx, next_idx = st.match_idx, st.next_idx
+
+    def bump(cond, new_term, term, role, voted_for, timeout):
+        """SPEC §3 term-change rule where cond."""
+        term2 = jnp.where(cond, new_term, term)
+        role2 = jnp.where(cond, ROLE_F, role)
+        vf2 = jnp.where(cond, NONE, voted_for)
+        to2 = jnp.where(cond, _draw_timeout(seed, cfg.t_min, cfg.t_max, term2, uidx),
+                        timeout)
+        return term2, role2, vf2, to2
+
+    # ---- P0 churn.
+    stepdown = churn & (role == ROLE_L)
+    role = jnp.where(stepdown, ROLE_F, role)
+    timer = jnp.where(stepdown, 0, timer)
+    reset = stepdown
+
+    # ---- P1 candidacy.
+    cand_new = (role != ROLE_L) & (timer >= timeout)
+    term = term + cand_new.astype(jnp.int32)
+    role = jnp.where(cand_new, ROLE_C, role)
+    voted_for = jnp.where(cand_new, idx, voted_for)
+    timer = jnp.where(cand_new, 0, timer)
+    reset |= cand_new
+    timeout = jnp.where(cand_new, _draw_timeout(seed, cfg.t_min, cfg.t_max, term, uidx),
+                        timeout)
+
+    # ---- P2 election. Requests snapshot (post-P1).
+    was_cand = role == ROLE_C
+    req_term, req_lidx = term, log_len
+    req_lterm = _last_term(log_term, log_len)
+
+    # P2a term catch-up: max delivered candidate term per receiver j.
+    sent_term = jnp.where((was_cand[:, None]) & deliver, req_term[:, None], 0)
+    t_in = jnp.max(sent_term, axis=0)
+    bumped = t_in > term
+    term, role, voted_for, timeout = bump(bumped, t_in, term, role, voted_for, timeout)
+
+    # P2b grants. elig[c, j]: candidate c's request is grantable at j.
+    own_lterm = req_lterm  # P2a mutates no log state; last terms are unchanged
+    up_to_date = (req_lterm[:, None] > own_lterm[None, :]) | (
+        (req_lterm[:, None] == own_lterm[None, :])
+        & (req_lidx[:, None] >= log_len[None, :]))
+    elig = was_cand[:, None] & deliver & (req_term[:, None] == term[None, :]) & up_to_date
+    vf_safe = jnp.clip(voted_for, 0, N - 1)
+    vf_elig = (voted_for >= 0) & elig[vf_safe, idx]
+    first_elig = jnp.min(jnp.where(elig, idx[:, None], N), axis=0)
+    grant = jnp.where(
+        vf_elig, voted_for,
+        jnp.where((voted_for == NONE) & (first_elig < N), first_elig, NONE))
+    granted = grant >= 0
+    voted_for = jnp.where(granted, grant, voted_for)
+    timer = jnp.where(granted, 0, timer)
+    reset |= granted
+
+    # P2c tally: votes[c] = 1 + Σ_j [grant_j == c ∧ delivered(j, c)].
+    votes = 1 + jnp.sum((grant[:, None] == idx[None, :]) & deliver, axis=0,
+                        dtype=jnp.int32)
+    win = (role == ROLE_C) & (votes >= majority)
+    role = jnp.where(win, ROLE_L, role)
+    timer = jnp.where(win, 0, timer)
+    reset |= win
+    match_idx = jnp.where(win[:, None],
+                          jnp.where(eye, log_len[:, None], 0), match_idx)
+    next_idx = jnp.where(win[:, None], log_len[:, None] + 1, next_idx)
+
+    # ---- P3a propose.
+    lead = role == ROLE_L
+    can_prop = lead & (log_len < E)
+    slot_hot = (jnp.arange(L, dtype=jnp.int32)[None, :] == log_len[:, None]) \
+        & can_prop[:, None]
+    prop_val = _i32(_draw(seed, rng.STREAM_VALUE, ur, 0, uidx))
+    log_term = jnp.where(slot_hot, term[:, None], log_term)
+    log_val = jnp.where(slot_hot, prop_val[:, None], log_val)
+    log_len = log_len + can_prop.astype(jnp.int32)
+    match_idx = jnp.where(eye & can_prop[:, None], log_len[:, None], match_idx)
+
+    # ---- P3b snapshot sender state (post-(a), commit pre-(e)).
+    was_leader = lead
+    s_term, s_len, s_commit = term, log_len, commit
+    s_next, s_logt, s_logv = next_idx, log_term, log_val
+
+    # ---- P3c receivers.
+    sent_lterm = jnp.where(was_leader[:, None] & deliver, s_term[:, None], 0)
+    t_in2 = jnp.max(sent_lterm, axis=0)
+    bumped2 = t_in2 > term
+    term, role, voted_for, timeout = bump(bumped2, t_in2, term, role, voted_for, timeout)
+
+    valid = was_leader[:, None] & deliver & (s_term[:, None] == term[None, :])
+    lstar = jnp.min(jnp.where(valid, idx[:, None], N), axis=0)
+    has_l = lstar < N
+    ls = jnp.clip(lstar, 0, N - 1)
+
+    timer = jnp.where(has_l, 0, timer)
+    reset |= has_l
+    role = jnp.where(has_l & (role == ROLE_C), ROLE_F, role)
+
+    prev = s_next[ls, idx] - 1                       # [N]
+    lrow_t = jnp.take(s_logt, ls, axis=0)            # [N, L] leader log rows
+    lrow_v = jnp.take(s_logv, ls, axis=0)
+    kprev = jnp.clip(prev - 1, 0, L - 1)[:, None]
+    prev_term_l = jnp.where(prev > 0,
+                            jnp.take_along_axis(lrow_t, kprev, axis=1)[:, 0], 0)
+    own_at_prev = jnp.where((prev > 0) & (prev <= log_len),
+                            jnp.take_along_axis(log_term, kprev, axis=1)[:, 0], 0)
+    ok = (prev == 0) | ((prev <= log_len) & (own_at_prev == prev_term_l))
+    apply_ = has_l & ok
+
+    l_len = s_len[ls]
+    karange = jnp.arange(L, dtype=jnp.int32)[None, :]
+    copy_mask = apply_[:, None] & (karange >= prev[:, None]) & (karange < l_len[:, None])
+    log_term = jnp.where(copy_mask, lrow_t, log_term)
+    log_val = jnp.where(copy_mask, lrow_v, log_val)
+    log_len = jnp.where(apply_, l_len, log_len)
+    commit = jnp.where(apply_, jnp.maximum(commit, jnp.minimum(s_commit[ls], log_len)),
+                       commit)
+    ack_to = jnp.where(has_l, ls, NONE)
+    ack_ok = apply_
+    ack_match = jnp.where(apply_, l_len, 0)
+    ack_term = term
+
+    # ---- P3d leaders process acks. ackm[j, l] = ack_to[j]==l ∧ delivered(j, l).
+    still_lead = was_leader & (role == ROLE_L)
+    ackm = (ack_to[:, None] == idx[None, :]) & deliver
+    t_in3 = jnp.max(jnp.where(ackm, ack_term[:, None], 0), axis=0)
+    bump3 = still_lead & (t_in3 > term)
+    term, role, voted_for, timeout = bump(bump3, t_in3, term, role, voted_for, timeout)
+    proc = still_lead & ~bump3
+
+    succ_lj = (ackm & ack_ok[:, None]).T             # [l, j]
+    fail_lj = (ackm & ~ack_ok[:, None]).T
+    match_idx = jnp.where(proc[:, None] & succ_lj,
+                          jnp.maximum(match_idx, ack_match[None, :]), match_idx)
+    next_idx = jnp.where(
+        proc[:, None] & succ_lj, match_idx + 1,
+        jnp.where(proc[:, None] & fail_lj, jnp.maximum(1, next_idx - 1), next_idx))
+
+    # ---- P3e commit advance: majority-th largest of match_idx row.
+    med = jnp.sort(match_idx, axis=1)[:, N - majority]
+    kmed = jnp.clip(med - 1, 0, L - 1)[:, None]
+    term_at_med = jnp.take_along_axis(log_term, kmed, axis=1)[:, 0]
+    adv = proc & (med > commit) & (med > 0) & (term_at_med == term)
+    commit = jnp.where(adv, med, commit)
+
+    # ---- P4 timers.
+    timer = jnp.where(role == ROLE_L, 0, jnp.where(reset, timer, timer + 1))
+
+    return RaftState(seed, term, role, voted_for, log_term, log_val, log_len,
+                     commit, timer, timeout, match_idx, next_idx)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _raft_run_jit(cfg: Config, seeds):
+    st0 = jax.vmap(lambda s: raft_init(cfg, s))(seeds)
+    rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
+
+    def scan_body(sts, r):
+        return jax.vmap(lambda s: raft_round(cfg, s, r))(sts), None
+
+    stF, _ = jax.lax.scan(scan_body, st0, rounds)
+    return stF
+
+
+def raft_run(cfg: Config):
+    """Run the full batched simulation. Returns host numpy arrays
+    {commit, log_term, log_val, term, role} with leading sweep axis [B, ...]."""
+    B = cfg.n_sweeps
+    seeds = ((np.uint64(cfg.seed) + np.arange(B, dtype=np.uint64))
+             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+    stF = _raft_run_jit(cfg, seeds)
+    out = {
+        "commit": np.asarray(stF.commit),
+        "log_term": np.asarray(stF.log_term),
+        "log_val": np.asarray(stF.log_val),
+        "term": np.asarray(stF.term),
+        "role": np.asarray(stF.role),
+    }
+    return out

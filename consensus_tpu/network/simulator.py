@@ -1,0 +1,93 @@
+"""The simulator driver — the framework analog of `network::Simulator` [B:5].
+
+One entry point runs any protocol on either engine and returns the decided
+logs in canonical serialized form plus throughput stats:
+
+    result = run(Config(protocol="raft", engine="tpu", ...))
+    result.digest          # SHA-256 of canonical decided-log bytes
+    result.steps_per_sec   # node-round-steps/sec (BASELINE.json:2)
+
+The TPU engine executes the whole run as one XLA program (scan over rounds,
+vmap over sweeps); the CPU engine loops the C++ scalar oracle over sweeps.
+Byte-equivalence of `result.payload` across engines is the framework's
+acceptance criterion (BASELINE.json:2,5).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.config import Config
+from ..core import serialize
+
+
+@dataclass
+class RunResult:
+    config: Config
+    payload: bytes          # canonical decided-log serialization
+    digest: str
+    wall_s: float
+    node_round_steps: int
+    counts: np.ndarray      # [B, N]
+    rec_a: np.ndarray       # [B, N, L]
+    rec_b: np.ndarray
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self.node_round_steps / self.wall_s if self.wall_s > 0 else 0.0
+
+
+def _decided_raft(out) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    # Decided records: (log_term[k], log_val[k]) for k < commit (SPEC §3).
+    return out["commit"], out["log_term"], out["log_val"]
+
+
+def run(cfg: Config, warmup: bool = True) -> RunResult:
+    """Run a config. With ``warmup`` (default) the TPU engine is executed
+    once before the timed run so ``wall_s`` measures steady-state execution,
+    not jit tracing + XLA compilation; the oracle's shared library is built
+    outside the window for the same reason. Pass ``warmup=False`` for a
+    single cold run when only the decided logs matter."""
+    if cfg.engine == "tpu":
+        if warmup:
+            _run_jax(cfg)  # compile (cached by (cfg, shapes)); discard result
+        t0 = time.perf_counter()
+        out = _run_jax(cfg)
+        wall = time.perf_counter() - t0
+    else:
+        from ..oracle import bindings
+        bindings.get_lib()  # build outside the timed window
+        t0 = time.perf_counter()
+        out = _run_oracle(cfg)
+        wall = time.perf_counter() - t0
+
+    if cfg.protocol == "raft":
+        counts, rec_a, rec_b = _decided_raft(out)
+    else:
+        counts, rec_a, rec_b = out["counts"], out["rec_a"], out["rec_b"]
+
+    counts = np.asarray(counts)
+    payload = serialize.serialize_decided(cfg.protocol, counts,
+                                          np.asarray(rec_a), np.asarray(rec_b))
+    return RunResult(
+        config=cfg, payload=payload, digest=serialize.digest(payload),
+        wall_s=wall,
+        node_round_steps=cfg.n_sweeps * cfg.n_nodes * cfg.n_rounds,
+        counts=counts, rec_a=np.asarray(rec_a), rec_b=np.asarray(rec_b))
+
+
+def _run_jax(cfg: Config):
+    if cfg.protocol == "raft":
+        from ..engines.raft import raft_run
+        return raft_run(cfg)
+    raise NotImplementedError(cfg.protocol)
+
+
+def _run_oracle(cfg: Config):
+    from ..oracle import bindings
+    if cfg.protocol == "raft":
+        outs = [bindings.raft_run(cfg, sweep=b) for b in range(cfg.n_sweeps)]
+        return {k: np.stack([o[k] for o in outs]) for k in outs[0]}
+    raise NotImplementedError(cfg.protocol)

@@ -1,0 +1,69 @@
+"""ctypes bindings to the C++ scalar oracle (cpp/liboracle.so).
+
+The oracle is the framework's CPU reference engine — the analog of the
+reference's Rust implementation (SURVEY.md §2, "native-component
+checklist"). pybind11 is not available in this environment, so the bridge
+is a plain C ABI + ctypes (task environment notes).
+
+The library is built on demand with `make -C cpp` the first time it is
+imported, so `pip`-less checkouts and CI just work.
+"""
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+
+import numpy as np
+
+_CPP_DIR = pathlib.Path(__file__).resolve().parents[2] / "cpp"
+_LIB_PATH = _CPP_DIR / "liboracle.so"
+_lib = None
+
+
+def _build() -> None:
+    subprocess.run(["make", "-C", str(_CPP_DIR), "-s"], check=True)
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    src_mtime = max((_CPP_DIR / f).stat().st_mtime for f in ("oracle.cpp", "threefry.h"))
+    if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < src_mtime:
+        _build()
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    p32 = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
+    lib.ctpu_random_u32.restype = u32
+    lib.ctpu_random_u32.argtypes = [u64, u32, u32, u32, u32]
+    lib.ctpu_raft_run.restype = ctypes.c_int
+    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 9 + [p32] * 5
+    _lib = lib
+    return lib
+
+
+def random_u32(seed: int, stream: int, ctx: int, c0: int, c1: int) -> int:
+    return int(get_lib().ctpu_random_u32(seed, stream, ctx, c0, c1))
+
+
+def raft_run(cfg, sweep: int = 0):
+    """Run one Raft sweep in the oracle. Returns dict of final arrays."""
+    lib = get_lib()
+    N, L = cfg.n_nodes, cfg.log_capacity
+    out = {
+        "commit": np.zeros(N, np.uint32),
+        "log_term": np.zeros((N, L), np.uint32),
+        "log_val": np.zeros((N, L), np.uint32),
+        "term": np.zeros(N, np.uint32),
+        "role": np.zeros(N, np.uint32),
+    }
+    seed = (cfg.seed + sweep) & 0xFFFFFFFFFFFFFFFF
+    rc = lib.ctpu_raft_run(
+        seed, N, cfg.n_rounds, L, cfg.max_entries, cfg.t_min, cfg.t_max,
+        cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
+        out["commit"], out["log_term"].reshape(-1), out["log_val"].reshape(-1),
+        out["term"], out["role"])
+    if rc != 0:
+        raise RuntimeError(f"oracle raft_run failed rc={rc}")
+    return out

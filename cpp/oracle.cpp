@@ -1,0 +1,309 @@
+// C++ scalar oracle — the CPU reference engine of the framework.
+//
+// Plays the role the Rust implementation plays in the reference
+// (`2892931976/consensus-rs`, SURVEY.md §2 components 1-12): a sequential,
+// per-node implementation of each consensus protocol against which the
+// batched JAX/TPU engine is checked for decided-log BYTE-equivalence
+// (BASELINE.json:2,5). Implements docs/SPEC.md exactly — every phase,
+// tie-break, and threefry draw. Exposed to Python via a C ABI (ctypes;
+// pybind11 is not available in this environment).
+//
+// Build: `make -C cpp` → liboracle.so.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "threefry.h"
+
+namespace ctpu {
+namespace {
+
+constexpr uint32_t ROLE_F = 0, ROLE_C = 1, ROLE_L = 2;
+constexpr int32_t NONE = -1;
+
+// Per-round delivery decisions (SPEC §2), materialized once per round —
+// each directed edge is queried up to ~7 times per round across the phases,
+// so recomputing the 20-round threefry per query would distort the
+// single-core baseline this oracle exists to provide (BASELINE.md).
+struct Net {
+  uint32_t n = 0;
+  std::vector<uint8_t> mat;  // [n*n] delivered?
+
+  void begin_round(uint64_t seed, uint32_t n_, uint32_t r, uint32_t drop_cut,
+                   uint32_t part_cut) {
+    n = n_;
+    mat.assign(size_t(n) * n, 0);
+    const bool part_active =
+        random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
+    std::vector<uint8_t> side(n, 0);
+    if (part_active)
+      for (uint32_t i = 0; i < n; ++i)
+        side[i] = random_u32(seed, STREAM_PARTITION, r, 1, i) & 1u;
+    for (uint32_t i = 0; i < n; ++i)
+      for (uint32_t j = 0; j < n; ++j) {
+        if (i == j) continue;
+        if (random_u32(seed, STREAM_DELIVER, r, i, j) < drop_cut) continue;
+        if (part_active && side[i] != side[j]) continue;
+        mat[size_t(i) * n + j] = 1;
+      }
+  }
+  bool delivered(uint32_t i, uint32_t j) const {
+    return mat[size_t(i) * n + j] != 0;
+  }
+};
+
+inline bool churn_fires(uint64_t seed, uint32_t r, uint32_t cut) {
+  return random_u32(seed, STREAM_CHURN, r, 0, 0) < cut;
+}
+
+// ---------------------------------------------------------------------------
+// Raft (SPEC §3).
+// ---------------------------------------------------------------------------
+
+struct RaftSim {
+  uint64_t seed;
+  uint32_t N, R, L, E, t_min, t_max;
+  uint32_t drop_cut, part_cut, churn_cut;
+
+  // State, struct-of-arrays to mirror the array schema (SURVEY.md §7).
+  std::vector<uint32_t> term, role, log_len, commit, timer, timeout;
+  std::vector<int32_t> voted_for;
+  std::vector<uint32_t> log_term, log_val;        // [N*L]
+  std::vector<uint32_t> match_idx, next_idx;      // [N*N]
+  Net net;
+
+  uint32_t& lt(uint32_t i, uint32_t k) { return log_term[i * L + k]; }
+  uint32_t& lv(uint32_t i, uint32_t k) { return log_val[i * L + k]; }
+  uint32_t& mi(uint32_t l, uint32_t j) { return match_idx[l * N + j]; }
+  uint32_t& ni(uint32_t l, uint32_t j) { return next_idx[l * N + j]; }
+
+  uint32_t draw_timeout(uint32_t t, uint32_t i) const {
+    return t_min + random_u32(seed, STREAM_TIMEOUT, t, 0, i) % (t_max - t_min);
+  }
+
+  // SPEC §3 term-change rule (non-candidacy causes).
+  void bump_term(uint32_t i, uint32_t T) {
+    term[i] = T;
+    role[i] = ROLE_F;
+    voted_for[i] = NONE;
+    timeout[i] = draw_timeout(T, i);
+  }
+
+  void init() {
+    term.assign(N, 0); role.assign(N, ROLE_F); log_len.assign(N, 0);
+    commit.assign(N, 0); timer.assign(N, 0); voted_for.assign(N, NONE);
+    timeout.resize(N);
+    log_term.assign(size_t(N) * L, 0); log_val.assign(size_t(N) * L, 0);
+    match_idx.assign(size_t(N) * N, 0); next_idx.assign(size_t(N) * N, 1);
+    for (uint32_t i = 0; i < N; ++i) timeout[i] = draw_timeout(0, i);
+  }
+
+  void round(uint32_t r) {
+    const uint32_t majority = N / 2 + 1;
+    net.begin_round(seed, N, r, drop_cut, part_cut);
+    std::vector<uint8_t> reset(N, 0);
+
+    // ---- P0 churn: all leaders step down.
+    if (churn_fires(seed, r, churn_cut))
+      for (uint32_t i = 0; i < N; ++i)
+        if (role[i] == ROLE_L) { role[i] = ROLE_F; timer[i] = 0; reset[i] = 1; }
+
+    // ---- P1 candidacy.
+    for (uint32_t i = 0; i < N; ++i)
+      if (role[i] != ROLE_L && timer[i] >= timeout[i]) {
+        term[i] += 1;
+        role[i] = ROLE_C;
+        voted_for[i] = int32_t(i);
+        timer[i] = 0; reset[i] = 1;
+        timeout[i] = draw_timeout(term[i], i);
+      }
+
+    // ---- P2 election. Snapshot requests (post-P1 sender state).
+    std::vector<uint8_t> was_cand(N);
+    std::vector<uint32_t> req_term(N), req_lidx(N), req_lterm(N);
+    for (uint32_t c = 0; c < N; ++c) {
+      was_cand[c] = role[c] == ROLE_C;
+      req_term[c] = term[c];
+      req_lidx[c] = log_len[c];
+      req_lterm[c] = log_len[c] ? lt(c, log_len[c] - 1) : 0;
+    }
+    // P2a: term catch-up from delivered requests.
+    for (uint32_t j = 0; j < N; ++j) {
+      uint32_t T = term[j];
+      for (uint32_t c = 0; c < N; ++c)
+        if (was_cand[c] && net.delivered(c, j)) T = std::max(T, req_term[c]);
+      if (T > term[j]) bump_term(j, T);
+    }
+    // P2b: grants.
+    std::vector<int32_t> grant(N, NONE);
+    for (uint32_t j = 0; j < N; ++j) {
+      uint32_t own_lterm = log_len[j] ? lt(j, log_len[j] - 1) : 0;
+      int32_t g = NONE;
+      auto eligible = [&](uint32_t c) {
+        if (!was_cand[c] || c == j || !net.delivered(c, j)) return false;
+        if (req_term[c] != term[j]) return false;
+        return req_lterm[c] > own_lterm ||
+               (req_lterm[c] == own_lterm && req_lidx[c] >= log_len[j]);
+      };
+      if (voted_for[j] != NONE) {
+        if (eligible(uint32_t(voted_for[j]))) g = voted_for[j];  // re-grant
+      } else {
+        for (uint32_t c = 0; c < N; ++c)
+          if (eligible(c)) { g = int32_t(c); break; }  // lowest id
+      }
+      if (g != NONE) { voted_for[j] = g; timer[j] = 0; reset[j] = 1; }
+      grant[j] = g;
+    }
+    // P2c: tally; winners become leaders.
+    for (uint32_t c = 0; c < N; ++c) {
+      if (role[c] != ROLE_C) continue;  // may have been bumped in P2a
+      uint32_t votes = 1;  // self
+      for (uint32_t j = 0; j < N; ++j)
+        if (j != c && grant[j] == int32_t(c) && net.delivered(j, c)) ++votes;
+      if (votes >= majority) {
+        role[c] = ROLE_L;
+        timer[c] = 0; reset[c] = 1;
+        for (uint32_t j = 0; j < N; ++j) { mi(c, j) = 0; ni(c, j) = log_len[c] + 1; }
+        mi(c, c) = log_len[c];
+      }
+    }
+
+    // ---- P3 replication.
+    // (a) propose.
+    for (uint32_t l = 0; l < N; ++l)
+      if (role[l] == ROLE_L && log_len[l] < E && log_len[l] < L) {
+        lt(l, log_len[l]) = term[l];
+        lv(l, log_len[l]) = random_u32(seed, STREAM_VALUE, r, 0, l);
+        log_len[l] += 1;
+        mi(l, l) = log_len[l];
+      }
+    // (b) snapshot sender state (post-(a), commit pre-(e)).
+    std::vector<uint8_t> was_leader(N);
+    std::vector<uint32_t> s_term(N), s_len(N), s_commit(N);
+    std::vector<uint32_t> s_next;  // [N*N] snapshot of next_idx
+    s_next = next_idx;
+    std::vector<uint32_t> s_logt = log_term, s_logv = log_val;
+    for (uint32_t l = 0; l < N; ++l) {
+      was_leader[l] = role[l] == ROLE_L;
+      s_term[l] = term[l]; s_len[l] = log_len[l]; s_commit[l] = commit[l];
+    }
+    // (c) receivers.
+    std::vector<int32_t> ack_to(N, NONE);
+    std::vector<uint8_t> ack_ok(N, 0);
+    std::vector<uint32_t> ack_match(N, 0), ack_term(N, 0);
+    for (uint32_t j = 0; j < N; ++j) {
+      uint32_t T = term[j];
+      for (uint32_t l = 0; l < N; ++l)
+        if (was_leader[l] && net.delivered(l, j)) T = std::max(T, s_term[l]);
+      if (T > term[j]) bump_term(j, T);
+      int32_t lstar = NONE;
+      for (uint32_t l = 0; l < N; ++l)
+        if (was_leader[l] && l != j && net.delivered(l, j) && s_term[l] == term[j]) {
+          lstar = int32_t(l);
+          break;  // lowest id
+        }
+      if (lstar == NONE) continue;
+      uint32_t l = uint32_t(lstar);
+      timer[j] = 0; reset[j] = 1;
+      if (role[j] == ROLE_C) role[j] = ROLE_F;
+      uint32_t prev = s_next[l * N + j] - 1;
+      uint32_t prev_term = prev ? s_logt[size_t(l) * L + prev - 1] : 0;
+      bool ok = prev == 0 ||
+                (prev <= log_len[j] && lt(j, prev - 1) == prev_term);
+      ack_to[j] = lstar;
+      ack_term[j] = term[j];
+      if (ok) {
+        for (uint32_t k = prev; k < s_len[l]; ++k) {
+          lt(j, k) = s_logt[size_t(l) * L + k];
+          lv(j, k) = s_logv[size_t(l) * L + k];
+        }
+        log_len[j] = s_len[l];
+        commit[j] = std::max(commit[j], std::min(s_commit[l], log_len[j]));
+        ack_ok[j] = 1;
+        ack_match[j] = s_len[l];
+      }
+    }
+    // (d) leaders process acks (only if still leader after (c)).
+    for (uint32_t l = 0; l < N; ++l) {
+      if (!was_leader[l] || role[l] != ROLE_L) continue;
+      uint32_t T = term[l];
+      for (uint32_t j = 0; j < N; ++j)
+        if (ack_to[j] == int32_t(l) && net.delivered(j, l))
+          T = std::max(T, ack_term[j]);
+      if (T > term[l]) { bump_term(l, T); continue; }
+      for (uint32_t j = 0; j < N; ++j) {
+        if (ack_to[j] != int32_t(l) || !net.delivered(j, l)) continue;
+        if (ack_ok[j]) {
+          mi(l, j) = std::max(mi(l, j), ack_match[j]);
+          ni(l, j) = mi(l, j) + 1;
+        } else {
+          ni(l, j) = std::max(1u, ni(l, j) - 1);
+        }
+      }
+      // (e) commit advance.
+      std::vector<uint32_t> m(match_idx.begin() + size_t(l) * N,
+                              match_idx.begin() + size_t(l) * N + N);
+      std::nth_element(m.begin(), m.begin() + (majority - 1), m.end(),
+                       std::greater<uint32_t>());
+      uint32_t med = m[majority - 1];
+      if (med > commit[l] && med > 0 && lt(l, med - 1) == term[l])
+        commit[l] = med;
+    }
+
+    // ---- P4 timers.
+    for (uint32_t i = 0; i < N; ++i) {
+      if (role[i] == ROLE_L) timer[i] = 0;
+      else if (!reset[i]) timer[i] += 1;
+    }
+  }
+
+  void run() {
+    init();
+    for (uint32_t r = 0; r < R; ++r) round(r);
+  }
+};
+
+}  // namespace
+}  // namespace ctpu
+
+// ---------------------------------------------------------------------------
+// C ABI (ctypes). One call runs one sweep; Python loops sweeps with
+// seed_b = base_seed + b (SPEC §1) and serializes via core/serialize.py.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                  uint32_t log_capacity, uint32_t max_entries,
+                  uint32_t t_min, uint32_t t_max,
+                  uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
+                  uint32_t* out_commit,    // [N]
+                  uint32_t* out_log_term,  // [N*L]
+                  uint32_t* out_log_val,   // [N*L]
+                  uint32_t* out_term,      // [N]
+                  uint32_t* out_role) {    // [N]
+  if (n_nodes == 0 || t_max <= t_min) return 1;
+  ctpu::RaftSim sim;
+  sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
+  sim.E = max_entries; sim.t_min = t_min; sim.t_max = t_max;
+  sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.run();
+  std::memcpy(out_commit, sim.commit.data(), sizeof(uint32_t) * n_nodes);
+  std::memcpy(out_log_term, sim.log_term.data(),
+              sizeof(uint32_t) * size_t(n_nodes) * log_capacity);
+  std::memcpy(out_log_val, sim.log_val.data(),
+              sizeof(uint32_t) * size_t(n_nodes) * log_capacity);
+  std::memcpy(out_term, sim.term.data(), sizeof(uint32_t) * n_nodes);
+  std::memcpy(out_role, sim.role.data(), sizeof(uint32_t) * n_nodes);
+  return 0;
+}
+
+// Threefry probe for cross-language RNG parity tests.
+uint32_t ctpu_random_u32(uint64_t seed, uint32_t stream, uint32_t ctx,
+                         uint32_t c0, uint32_t c1) {
+  return ctpu::random_u32(seed, stream, ctx, c0, c1);
+}
+
+}  // extern "C"

@@ -1,0 +1,55 @@
+// Threefry-2x32 (20 rounds) — scalar twin of consensus_tpu/core/rng.py.
+// The C++ oracle and the JAX engine must draw IDENTICAL random streams for
+// decided-log byte-equivalence (BASELINE.json:2,5); both implement the
+// Random123 Threefry-2x32 schedule and the same (seed^stream, ctx)/(hi,lo)
+// key/counter discipline. Validated against the Python twin in
+// tests/test_oracle_bindings.py.
+#pragma once
+#include <cstdint>
+
+namespace ctpu {
+
+// Stream constants — must match consensus_tpu/core/rng.py.
+constexpr uint32_t STREAM_DELIVER   = 0x9E3779B1u;
+constexpr uint32_t STREAM_TIMEOUT   = 0x85EBCA77u;
+constexpr uint32_t STREAM_CHURN     = 0xC2B2AE3Du;
+constexpr uint32_t STREAM_PARTITION = 0x27D4EB2Fu;
+constexpr uint32_t STREAM_STAKE     = 0x165667B1u;
+constexpr uint32_t STREAM_VOTE      = 0xD3A2646Cu;
+constexpr uint32_t STREAM_VALUE     = 0xFD7046C5u;
+constexpr uint32_t STREAM_BYZANTINE = 0xB55A4F09u;
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+struct U32x2 { uint32_t v0, v1; };
+
+inline U32x2 threefry2x32(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c1) {
+  constexpr uint32_t KS_PARITY = 0x1BD11BDAu;
+  constexpr int ROT_A[4] = {13, 15, 26, 6};
+  constexpr int ROT_B[4] = {17, 29, 16, 24};
+  uint32_t ks[3] = {k0, k1, k0 ^ k1 ^ KS_PARITY};
+  uint32_t x0 = c0 + ks[0];
+  uint32_t x1 = c1 + ks[1];
+  for (int block = 0; block < 5; ++block) {
+    const int* rots = (block % 2 == 0) ? ROT_A : ROT_B;
+    for (int i = 0; i < 4; ++i) {
+      x0 += x1;
+      x1 = rotl32(x1, rots[i]) ^ x0;
+    }
+    x0 += ks[(block + 1) % 3];
+    x1 += ks[(block + 2) % 3] + static_cast<uint32_t>(block + 1);
+  }
+  return {x0, x1};
+}
+
+// Draw one u32 word: key=(lo32(seed)^stream, ctx), ctr=(c0, c1).
+// See docs/SPEC.md §1 for the stream table.
+inline uint32_t random_u32(uint64_t seed, uint32_t stream, uint32_t ctx,
+                           uint32_t c0, uint32_t c1) {
+  uint32_t k0 = static_cast<uint32_t>(seed & 0xFFFFFFFFull) ^ stream;
+  return threefry2x32(k0, ctx, c0, c1).v0;
+}
+
+}  // namespace ctpu

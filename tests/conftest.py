@@ -1,0 +1,12 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh (SURVEY.md §4.4).
+
+Must set env BEFORE jax initializes a backend. Tests exercise the same
+shard_map code path that runs on a real v5e-8; bench.py (not under pytest)
+uses the real TPU chip.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

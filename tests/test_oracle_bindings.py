@@ -1,0 +1,16 @@
+"""Cross-language RNG parity: C++ threefry == numpy threefry."""
+import numpy as np
+
+from consensus_tpu.core import rng
+from consensus_tpu.oracle import bindings
+
+
+def test_threefry_cpp_matches_numpy():
+    r = np.random.RandomState(7)
+    for _ in range(50):
+        seed = int(r.randint(0, 2**63, dtype=np.int64))
+        stream = rng.STREAM_DELIVER if r.rand() < 0.5 else rng.STREAM_TIMEOUT
+        ctx, c0, c1 = (int(x) for x in r.randint(0, 2**32, size=3, dtype=np.uint32))
+        a = bindings.random_u32(seed, int(stream), ctx, c0, c1)
+        b = int(rng.random_u32_np(seed, stream, ctx, c0, c1))
+        assert a == b

@@ -1,0 +1,44 @@
+"""Differential tests: TPU-engine Raft vs C++ oracle, byte-equal decided logs.
+
+This is the framework's acceptance criterion (BASELINE.json:2,5;
+SURVEY.md §4.3): both engines run identical (config, seed) and must produce
+identical canonical serializations — compared on raw bytes, reported as
+SHA-256 digests.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from consensus_tpu import Config
+from consensus_tpu.network import simulator
+
+CLEAN = Config(protocol="raft", n_nodes=5, n_rounds=64, log_capacity=128,
+               max_entries=100, n_sweeps=2, seed=7)
+ADVERSARIAL = [
+    dataclasses.replace(CLEAN, drop_rate=0.25, seed=11, n_sweeps=4),
+    dataclasses.replace(CLEAN, partition_rate=0.3, seed=12, n_sweeps=4),
+    dataclasses.replace(CLEAN, churn_rate=0.1, seed=13, n_sweeps=4),
+    dataclasses.replace(CLEAN, n_nodes=9, drop_rate=0.3, partition_rate=0.2,
+                        churn_rate=0.05, n_rounds=128, seed=14, n_sweeps=4),
+]
+
+
+@pytest.mark.parametrize("cfg", [CLEAN] + ADVERSARIAL)
+def test_raft_decided_log_byte_equivalence(cfg):
+    tpu = simulator.run(dataclasses.replace(cfg, engine="tpu"))
+    cpu = simulator.run(dataclasses.replace(cfg, engine="cpu"))
+    assert tpu.digest == cpu.digest
+    assert tpu.payload == cpu.payload
+
+
+def test_raft_makes_progress_clean():
+    res = simulator.run(dataclasses.replace(CLEAN, engine="tpu"))
+    # A clean 64-round run must elect a leader and commit a healthy log.
+    assert res.counts.max() >= 40
+
+
+def test_raft_rerun_bitwise_deterministic():
+    a = simulator.run(dataclasses.replace(CLEAN, engine="tpu"))
+    b = simulator.run(dataclasses.replace(CLEAN, engine="tpu"))
+    assert a.payload == b.payload
