@@ -46,6 +46,7 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     # PBFT.
     f: int = 1                   # byzantine tolerance; n_nodes = 3f+1
     view_timeout: int = 8        # rounds without progress before view change
+    n_byzantine: int = 0         # silent-faulty nodes (ids >= N - n_byzantine)
 
     # Paxos.
     n_proposers: int = 0         # 0 ⇒ all nodes propose
@@ -71,6 +72,8 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             if self.n_nodes != expect:
                 raise ValueError(
                     f"pbft requires n_nodes == 3f+1 == {expect}, got {self.n_nodes}")
+            if self.n_byzantine > self.f:
+                raise ValueError("n_byzantine must be <= f")
         if self.t_max <= self.t_min:
             raise ValueError("t_max must exceed t_min")
 

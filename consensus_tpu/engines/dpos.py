@@ -1,0 +1,116 @@
+"""DPoS as a JAX array kernel (docs/SPEC.md §7).
+
+The reference's `dpos::vote` stake-weighted sum over up to 100k validators
+with an epoch schedule [B:5, B:11] maps to `jax.ops.segment_sum` of stakes
+by candidate, a stable top-K for the producer set, and a scan over rounds
+that touches only one producer row per round — O(V) per round, O(E·V) for
+all epoch tallies, never O(V²).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.config import Config
+from .raft import _draw, _lt
+
+
+class DposState(NamedTuple):
+    seed: jnp.ndarray       # [] uint32
+    chain_r: jnp.ndarray    # [V, L] i32 — block round
+    chain_p: jnp.ndarray    # [V, L] i32 — block producer
+    chain_len: jnp.ndarray  # [V] i32
+
+
+def dpos_schedule(cfg: Config, seed):
+    """Per-epoch stakes → votes → tally → top-K producers (SPEC §7)."""
+    V, C, K = cfg.n_nodes, cfg.n_candidates, cfg.n_producers
+    E = -(-cfg.n_rounds // cfg.epoch_len)
+    v_idx = jnp.arange(V, dtype=jnp.uint32)
+    stake = (_draw(seed, rng.STREAM_STAKE, 0, 0, v_idx)
+             % jnp.uint32(1000) + 1).astype(jnp.int32)
+
+    def epoch_producers(e):
+        vote = (_draw(seed, rng.STREAM_VOTE, e, 0, v_idx)
+                % jnp.uint32(C)).astype(jnp.int32)
+        tally = jax.ops.segment_sum(stake, vote, num_segments=C)
+        order = jnp.argsort(-tally, stable=True)  # ties → lower id first
+        return order[:K].astype(jnp.int32), tally
+
+    producers, tallies = jax.vmap(epoch_producers)(
+        jnp.arange(E, dtype=jnp.uint32))
+    return stake, producers, tallies  # [V], [E, K], [E, C]
+
+
+def _producer_delivery(cfg: Config, seed, r, p):
+    """Delivery row deliver(p, v) for the single producer p (SPEC §2)."""
+    V = cfg.n_nodes
+    v_idx = jnp.arange(V, dtype=jnp.uint32)
+    ur = jnp.asarray(r, jnp.uint32)
+    up = jnp.asarray(p, jnp.uint32)
+    dropped = (_draw(seed, rng.STREAM_DELIVER, ur, up, v_idx)
+               < _lt(cfg.drop_cutoff))
+    part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
+                   < _lt(cfg.partition_cutoff))
+    side = _draw(seed, rng.STREAM_PARTITION, ur, 1, v_idx) & jnp.uint32(1)
+    side_p = _draw(seed, rng.STREAM_PARTITION, ur, 1, up) & jnp.uint32(1)
+    ok = (~dropped) & ((side == side_p) | ~part_active)
+    return ok & (v_idx != up)  # self handled separately
+
+
+def dpos_round(cfg: Config, producers, st: DposState, r) -> DposState:
+    V, L = cfg.n_nodes, cfg.log_capacity
+    seed = st.seed
+    e = r // cfg.epoch_len
+    t = r % cfg.epoch_len
+    p = producers[e, t % cfg.n_producers]
+    churn = _draw(seed, rng.STREAM_CHURN, jnp.asarray(r, jnp.uint32), 0, 0) \
+        < _lt(cfg.churn_cutoff)
+
+    recv = _producer_delivery(cfg, seed, r, p)
+    recv = recv | (jnp.arange(V, dtype=jnp.int32) == p)   # self-append
+    append = recv & ~churn & (st.chain_len < L)
+
+    slot_hot = (jnp.arange(L, dtype=jnp.int32)[None, :] == st.chain_len[:, None]) \
+        & append[:, None]
+    chain_r = jnp.where(slot_hot, jnp.asarray(r, jnp.int32), st.chain_r)
+    chain_p = jnp.where(slot_hot, p, st.chain_p)
+    chain_len = st.chain_len + append.astype(jnp.int32)
+    return DposState(seed, chain_r, chain_p, chain_len)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _dpos_run_jit(cfg: Config, seeds):
+    def one(seed):
+        _, producers, _ = dpos_schedule(cfg, seed)
+        V, L = cfg.n_nodes, cfg.log_capacity
+        st0 = DposState(jnp.asarray(seed, jnp.uint32),
+                        jnp.zeros((V, L), jnp.int32),
+                        jnp.zeros((V, L), jnp.int32),
+                        jnp.zeros(V, jnp.int32))
+        rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
+
+        def body(st, r):
+            return dpos_round(cfg, producers, st, r), None
+
+        stF, _ = jax.lax.scan(body, st0, rounds)
+        return stF
+
+    return jax.vmap(one)(seeds)
+
+
+def dpos_run(cfg: Config):
+    B = cfg.n_sweeps
+    seeds = ((np.uint64(cfg.seed) + np.arange(B, dtype=np.uint64))
+             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    stF = _dpos_run_jit(cfg, seeds)
+    return {
+        "chain_r": np.asarray(stF.chain_r),
+        "chain_p": np.asarray(stF.chain_p),
+        "chain_len": np.asarray(stF.chain_len),
+    }

@@ -1,0 +1,143 @@
+"""Multi-decree Paxos as a JAX array kernel (docs/SPEC.md §5).
+
+The reference's `paxos::acceptor` promise/accept hot loop [B:5] becomes
+elementwise max/where updates over a `[acceptor, slot]` ballot grid
+(SURVEY.md §2 component 7), with per-round proposer contention resolved by
+segment-max scatters — each proposer touches one slot per round, so the
+kernel is O(N·P) per round, never O(N·S·P).
+
+The synchronous-round collapse of the two phases is safe: a proposer only
+sends Accepts after a majority of Promises, and within a round the accept
+set of a lower ballot is disjoint from the prepare-reach of any higher
+ballot on the same slot (same per-edge delivery decision for both flights),
+so two values can never both reach accept-majority — the classic Paxos
+argument carries over; see SPEC §5.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.config import Config
+from .raft import _delivery, _draw, _i32, _lt  # shared SPEC §2 adversary
+
+
+class PaxosState(NamedTuple):
+    seed: jnp.ndarray          # [] uint32
+    promised: jnp.ndarray      # [N, S] i32 (0 = none)
+    acc_bal: jnp.ndarray       # [N, S] i32
+    acc_val: jnp.ndarray       # [N, S] i32
+    learned_val: jnp.ndarray   # [N, S] i32
+    learned_mask: jnp.ndarray  # [N, S] bool
+
+
+def paxos_init(cfg: Config, seed) -> PaxosState:
+    N, S = cfg.n_nodes, cfg.log_capacity
+    z = jnp.zeros((N, S), jnp.int32)
+    return PaxosState(jnp.asarray(seed, jnp.uint32), z, z, z, z,
+                      jnp.zeros((N, S), bool))
+
+
+def paxos_round(cfg: Config, st: PaxosState, r) -> PaxosState:
+    N, S = cfg.n_nodes, cfg.log_capacity
+    P = cfg.n_proposers or N
+    majority = N // 2 + 1
+    seed = st.seed
+    ur = jnp.asarray(r, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    eye = jnp.eye(N, dtype=bool)
+
+    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+
+    is_prop = (idx < P) & ~churn
+    slot_p = (_draw(seed, rng.STREAM_VALUE, ur, 1, idx.astype(jnp.uint32))
+              % jnp.uint32(S)).astype(jnp.int32)
+    ballot = r * N + idx + 1
+    v_own = _i32(_draw(seed, rng.STREAM_VALUE, ur, 0, idx.astype(jnp.uint32)))
+
+    prep_del = deliver.T        # [a, p]: prepare/accept p→a delivered
+    resp_del = deliver          # [a, p]: response a→p delivered
+
+    seg_max = jax.vmap(lambda d: jnp.maximum(
+        jax.ops.segment_max(d, slot_p, num_segments=S), 0))
+
+    # Phase 1: prepares → per-slot max delivered ballot at each acceptor.
+    data1 = jnp.where(is_prop[None, :] & prep_del, ballot[None, :], 0)  # [A, P]
+    p_max = seg_max(data1)                                              # [A, S]
+    new_promised = jnp.maximum(st.promised, p_max)
+
+    # Phase 2: promises (only the highest delivered ballot per slot wins).
+    po = jnp.take_along_axis(st.promised, slot_p[None, :].repeat(N, 0), axis=1)
+    npo = jnp.take_along_axis(new_promised, slot_p[None, :].repeat(N, 0), axis=1)
+    prom = (is_prop[None, :] & prep_del & resp_del
+            & (ballot[None, :] > po) & (ballot[None, :] == npo))        # [A, P]
+    rep_bal = jnp.where(
+        prom, jnp.take_along_axis(st.acc_bal, slot_p[None, :].repeat(N, 0), axis=1), 0)
+    n_prom = jnp.sum(prom, axis=0, dtype=jnp.int32)
+    best_a = jnp.argmax(rep_bal, axis=0).astype(jnp.int32)  # first max ⇒ lowest id
+    best_bal = jnp.max(rep_bal, axis=0)
+    rep_val = st.acc_val[best_a, slot_p]                                # [P]
+
+    # Phase 3: proposer gate + value choice.
+    proceed = is_prop & (n_prom >= majority)
+    v_chosen = jnp.where(best_bal > 0, rep_val, v_own)
+
+    # Phase 4: accepts.
+    acc_cond = proceed[None, :] & prep_del & (ballot[None, :] >= npo)   # [A, P]
+    a_max = seg_max(jnp.where(acc_cond, ballot[None, :], 0))            # [A, S]
+    has_acc = a_max > 0
+    p_star = jnp.clip(a_max - (r * N + 1), 0, N - 1)
+    acc_bal2 = jnp.where(has_acc, a_max, st.acc_bal)
+    acc_val2 = jnp.where(has_acc, v_chosen[p_star], st.acc_val)
+    promised2 = jnp.where(has_acc, a_max, new_promised)
+
+    # Phase 5: accepted responses → decide.
+    amax_at = jnp.take_along_axis(a_max, slot_p[None, :].repeat(N, 0), axis=1)
+    accd = acc_cond & (ballot[None, :] == amax_at) & resp_del
+    n_acc = jnp.sum(accd, axis=0, dtype=jnp.int32)
+    decided = proceed & (n_acc >= majority)
+
+    # Phase 6: decide broadcast; learn from lowest-id decider, first wins.
+    reach = decided[:, None] & (deliver | eye)                          # [p, n]
+    seg_min = jax.vmap(lambda d: jnp.minimum(
+        jax.ops.segment_min(d, slot_p, num_segments=S), N))
+    pmin = seg_min(jnp.where(reach, idx[:, None], N).T)                 # [n, S]
+    found = pmin < N
+    lv_in = v_chosen[jnp.clip(pmin, 0, N - 1)]
+    learn_now = found & ~st.learned_mask
+    learned_val = jnp.where(learn_now, lv_in, st.learned_val)
+    learned_mask = st.learned_mask | found
+
+    return PaxosState(seed, promised2, acc_bal2, acc_val2, learned_val, learned_mask)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _paxos_run_jit(cfg: Config, seeds):
+    st0 = jax.vmap(lambda s: paxos_init(cfg, s))(seeds)
+    rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
+
+    def scan_body(sts, r):
+        return jax.vmap(lambda s: paxos_round(cfg, s, r))(sts), None
+
+    stF, _ = jax.lax.scan(scan_body, st0, rounds)
+    return stF
+
+
+def paxos_run(cfg: Config):
+    B = cfg.n_sweeps
+    seeds = ((np.uint64(cfg.seed) + np.arange(B, dtype=np.uint64))
+             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    stF = _paxos_run_jit(cfg, seeds)
+    return {
+        "learned_mask": np.asarray(stF.learned_mask),
+        "learned_val": np.asarray(stF.learned_val),
+        "promised": np.asarray(stF.promised),
+        "acc_bal": np.asarray(stF.acc_bal),
+        "acc_val": np.asarray(stF.acc_val),
+    }

@@ -1,0 +1,162 @@
+"""PBFT as a JAX array kernel (docs/SPEC.md §6).
+
+The reference's `pbft::quorum` prepare+commit vote tallies [B:5] become
+value-matched masked reductions: `count[j,s] = Σ_i delivered(i,j) ∧
+pp_val[i,s] == pp_val[j,s]` compared against Q = 2f+1 (SURVEY.md §2
+component 5). The f = 1..128 sweep [B:9] runs as a batch axis over
+separately-compiled (N = 3f+1)-shaped programs (shapes differ per f).
+
+View changes use the f+1 catch-up rule and are made certificate-free-safe
+by the prepared-refusal rule (see SPEC §6 safety argument).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.config import Config
+from .raft import _delivery, _draw, _i32, _lt
+
+
+class PbftState(NamedTuple):
+    seed: jnp.ndarray       # [] uint32
+    view: jnp.ndarray       # [N] i32
+    timer: jnp.ndarray      # [N] i32
+    pp_seen: jnp.ndarray    # [N, S] bool
+    pp_view: jnp.ndarray    # [N, S] i32
+    pp_val: jnp.ndarray     # [N, S] i32
+    prepared: jnp.ndarray   # [N, S] bool
+    committed: jnp.ndarray  # [N, S] bool
+    dval: jnp.ndarray       # [N, S] i32
+
+
+def pbft_init(cfg: Config, seed) -> PbftState:
+    N, S = cfg.n_nodes, cfg.log_capacity
+    z = jnp.zeros(N, jnp.int32)
+    zs = jnp.zeros((N, S), jnp.int32)
+    bs = jnp.zeros((N, S), bool)
+    return PbftState(jnp.asarray(seed, jnp.uint32), z, z, bs, zs, zs, bs, bs, zs)
+
+
+def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
+    N, S = cfg.n_nodes, cfg.log_capacity
+    f = cfg.f
+    Q = 2 * f + 1
+    seed = st.seed
+    ur = jnp.asarray(r, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    sarange = jnp.arange(S, dtype=jnp.int32)
+
+    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+    honest = idx < (N - cfg.n_byzantine)          # byzantine-silent senders
+    d_h = deliver & honest[:, None]               # honest-sender delivery
+    d_self_h = (deliver | jnp.eye(N, dtype=bool)) & honest[:, None]
+
+    view, timer = st.view, st.timer
+    pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
+    prepared, committed, dval = st.prepared, st.committed, st.dval
+    committed_at_start = committed
+
+    # ---- P0 churn: synchronized view bump.
+    view = view + churn.astype(jnp.int32)
+    timer = jnp.where(churn, 0, timer)
+    reset = jnp.broadcast_to(churn, (N,))
+
+    # ---- P1 view catch-up: (f+1)-th largest delivered honest view ∪ own.
+    w = jnp.where(d_h, view[:, None], -1)                       # [i, j]
+    w = jnp.where(jnp.eye(N, dtype=bool), view[None, :], w)     # include self
+    vth = jnp.sort(w, axis=0)[N - 1 - f, :]                     # (f+1)-th largest
+    catch = vth > view
+    view = jnp.where(catch, vth, view)
+    timer = jnp.where(catch, 0, timer)
+    reset |= catch
+
+    # ---- P2 timeout.
+    to = timer >= cfg.view_timeout
+    view = view + to.astype(jnp.int32)
+    timer = jnp.where(to, 0, timer)
+    reset |= to
+
+    # ---- P3 pre-prepare.
+    is_primary = honest & (view % N == idx)
+    fresh = jnp.min(jnp.where(~pp_seen, sarange[None, :], S), axis=1)  # [N]
+    fresh_hot = (sarange[None, :] == fresh[:, None])                   # [N, S]
+    ppb = is_primary[:, None] & ((pp_seen & ~committed) | fresh_hot)
+    fresh_val = _i32(_draw(seed, rng.STREAM_VALUE,
+                           view[:, None].astype(jnp.uint32), 2,
+                           sarange[None, :].astype(jnp.uint32)))       # [N, S]
+    msg_val = jnp.where(pp_seen, pp_val, fresh_val)
+
+    prim = view % N                                # [N] receiver's primary
+    del_self = deliver | jnp.eye(N, dtype=bool)
+    prim_ok = del_self[prim, idx] & (view[prim] == view)               # [N]
+    pm_b = ppb[prim]                               # [N, S] primary's broadcast
+    pm_val = msg_val[prim]
+    accept = (prim_ok[:, None] & pm_b
+              & (~pp_seen | (pp_view < view[:, None]))
+              & (~prepared | (pm_val == pp_val)))
+    pp_view = jnp.where(accept, view[:, None], pp_view)
+    pp_val = jnp.where(accept, pm_val, pp_val)
+    pp_seen = pp_seen | accept
+
+    # ---- P4 prepare tally (value-matched, incl. self).
+    val_eq = pp_val[:, None, :] == pp_val[None, :, :]                  # [i, j, s]
+    pcount = jnp.sum(d_self_h[:, :, None] & pp_seen[:, None, :] & val_eq,
+                     axis=0, dtype=jnp.int32)                          # [j, s]
+    prepared = prepared | (pp_seen & (pcount >= Q))
+
+    # ---- P5 commit tally.
+    ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
+                     axis=0, dtype=jnp.int32)
+    commit_now = prepared & (ccount >= Q) & ~committed
+    dval = jnp.where(commit_now, pp_val, dval)
+    committed = committed | commit_now
+
+    # ---- P6 decide gossip: adopt from lowest-id delivered decider.
+    dec_b = committed & honest[:, None]
+    imin = jnp.min(jnp.where(d_h[:, :, None] & dec_b[:, None, :],
+                             idx[:, None, None], N), axis=0)           # [j, s]
+    adopt = (imin < N) & ~committed
+    dval = jnp.where(adopt, dval[jnp.clip(imin, 0, N - 1), sarange[None, :]], dval)
+    committed = committed | adopt
+
+    # ---- P7 timer.
+    new_commit = jnp.any(committed & ~committed_at_start, axis=1)
+    timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
+                      timer + 1)
+
+    return PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
+                     prepared, committed, dval)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _pbft_run_jit(cfg: Config, seeds):
+    st0 = jax.vmap(lambda s: pbft_init(cfg, s))(seeds)
+    rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
+
+    def scan_body(sts, r):
+        return jax.vmap(lambda s: pbft_round(cfg, s, r))(sts), None
+
+    stF, _ = jax.lax.scan(scan_body, st0, rounds)
+    return stF
+
+
+def pbft_run(cfg: Config):
+    B = cfg.n_sweeps
+    seeds = ((np.uint64(cfg.seed) + np.arange(B, dtype=np.uint64))
+             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    stF = _pbft_run_jit(cfg, seeds)
+    return {
+        "committed": np.asarray(stF.committed),
+        "dval": np.asarray(stF.dval),
+        "view": np.asarray(stF.view),
+        "prepared": np.asarray(stF.prepared),
+        "pp_val": np.asarray(stF.pp_val),
+        "pp_seen": np.asarray(stF.pp_seen),
+    }

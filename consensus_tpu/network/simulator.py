@@ -65,6 +65,17 @@ def run(cfg: Config, warmup: bool = True) -> RunResult:
 
     if cfg.protocol == "raft":
         counts, rec_a, rec_b = _decided_raft(out)
+    elif cfg.protocol == "paxos":
+        counts, rec_a, rec_b = serialize.pack_sparse(
+            np.asarray(out["learned_mask"]).astype(bool),
+            np.asarray(out["learned_val"]))
+    elif cfg.protocol == "pbft":
+        counts, rec_a, rec_b = serialize.pack_sparse(
+            np.asarray(out["committed"]).astype(bool),
+            np.asarray(out["dval"]))
+    elif cfg.protocol == "dpos":
+        counts = np.asarray(out["chain_len"])
+        rec_a, rec_b = np.asarray(out["chain_r"]), np.asarray(out["chain_p"])
     else:
         counts, rec_a, rec_b = out["counts"], out["rec_a"], out["rec_b"]
 
@@ -82,12 +93,24 @@ def _run_jax(cfg: Config):
     if cfg.protocol == "raft":
         from ..engines.raft import raft_run
         return raft_run(cfg)
+    if cfg.protocol == "paxos":
+        from ..engines.paxos import paxos_run
+        return paxos_run(cfg)
+    if cfg.protocol == "pbft":
+        from ..engines.pbft import pbft_run
+        return pbft_run(cfg)
+    if cfg.protocol == "dpos":
+        from ..engines.dpos import dpos_run
+        return dpos_run(cfg)
     raise NotImplementedError(cfg.protocol)
 
 
 def _run_oracle(cfg: Config):
     from ..oracle import bindings
-    if cfg.protocol == "raft":
-        outs = [bindings.raft_run(cfg, sweep=b) for b in range(cfg.n_sweeps)]
-        return {k: np.stack([o[k] for o in outs]) for k in outs[0]}
-    raise NotImplementedError(cfg.protocol)
+    runners = {"raft": bindings.raft_run, "paxos": bindings.paxos_run,
+               "pbft": bindings.pbft_run, "dpos": bindings.dpos_run}
+    if cfg.protocol not in runners:
+        raise NotImplementedError(cfg.protocol)
+    fn = runners[cfg.protocol]
+    outs = [fn(cfg, sweep=b) for b in range(cfg.n_sweeps)]
+    return {k: np.stack([o[k] for o in outs]) for k in outs[0]}

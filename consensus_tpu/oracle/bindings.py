@@ -39,6 +39,13 @@ def get_lib() -> ctypes.CDLL:
     lib.ctpu_random_u32.argtypes = [u64, u32, u32, u32, u32]
     lib.ctpu_raft_run.restype = ctypes.c_int
     lib.ctpu_raft_run.argtypes = [u64] + [u32] * 9 + [p32] * 5
+    p8 = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+    lib.ctpu_paxos_run.restype = ctypes.c_int
+    lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 7 + [p32, p8, p32, p32, p32]
+    lib.ctpu_pbft_run.restype = ctypes.c_int
+    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 9 + [p8, p32, p32]
+    lib.ctpu_dpos_run.restype = ctypes.c_int
+    lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 9 + [p32] * 3
     _lib = lib
     return lib
 
@@ -66,4 +73,66 @@ def raft_run(cfg, sweep: int = 0):
         out["term"], out["role"])
     if rc != 0:
         raise RuntimeError(f"oracle raft_run failed rc={rc}")
+    return out
+
+
+def paxos_run(cfg, sweep: int = 0):
+    """Run one Paxos sweep in the oracle. Returns dict of final arrays."""
+    lib = get_lib()
+    N, S = cfg.n_nodes, cfg.log_capacity
+    out = {
+        "learned_val": np.zeros((N, S), np.uint32),
+        "learned_mask": np.zeros((N, S), np.uint8),
+        "promised": np.zeros((N, S), np.uint32),
+        "acc_bal": np.zeros((N, S), np.uint32),
+        "acc_val": np.zeros((N, S), np.uint32),
+    }
+    seed = (cfg.seed + sweep) & 0xFFFFFFFFFFFFFFFF
+    rc = lib.ctpu_paxos_run(
+        seed, N, cfg.n_rounds, S, cfg.n_proposers,
+        cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
+        out["learned_val"].reshape(-1), out["learned_mask"].reshape(-1),
+        out["promised"].reshape(-1), out["acc_bal"].reshape(-1),
+        out["acc_val"].reshape(-1))
+    if rc != 0:
+        raise RuntimeError(f"oracle paxos_run failed rc={rc}")
+    return out
+
+
+def pbft_run(cfg, sweep: int = 0):
+    """Run one PBFT sweep in the oracle. Returns dict of final arrays."""
+    lib = get_lib()
+    N, S = cfg.n_nodes, cfg.log_capacity
+    out = {
+        "committed": np.zeros((N, S), np.uint8),
+        "dval": np.zeros((N, S), np.uint32),
+        "view": np.zeros(N, np.uint32),
+    }
+    seed = (cfg.seed + sweep) & 0xFFFFFFFFFFFFFFFF
+    rc = lib.ctpu_pbft_run(
+        seed, N, cfg.n_rounds, S, cfg.f, cfg.view_timeout, cfg.n_byzantine,
+        cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
+        out["committed"].reshape(-1), out["dval"].reshape(-1), out["view"])
+    if rc != 0:
+        raise RuntimeError(f"oracle pbft_run failed rc={rc}")
+    return out
+
+
+def dpos_run(cfg, sweep: int = 0):
+    """Run one DPoS sweep in the oracle. Returns dict of final arrays."""
+    lib = get_lib()
+    V, L = cfg.n_nodes, cfg.log_capacity
+    out = {
+        "chain_r": np.zeros((V, L), np.uint32),
+        "chain_p": np.zeros((V, L), np.uint32),
+        "chain_len": np.zeros(V, np.uint32),
+    }
+    seed = (cfg.seed + sweep) & 0xFFFFFFFFFFFFFFFF
+    rc = lib.ctpu_dpos_run(
+        seed, V, cfg.n_rounds, L, cfg.n_candidates, cfg.n_producers,
+        cfg.epoch_len, cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
+        out["chain_r"].reshape(-1), out["chain_p"].reshape(-1),
+        out["chain_len"])
+    if rc != 0:
+        raise RuntimeError(f"oracle dpos_run failed rc={rc}")
     return out

@@ -265,6 +265,347 @@ struct RaftSim {
   }
 };
 
+// ---------------------------------------------------------------------------
+// PBFT (SPEC §6).
+// ---------------------------------------------------------------------------
+
+struct PbftSim {
+  uint64_t seed;
+  uint32_t N, R, S, f, view_timeout, n_byz;
+  uint32_t drop_cut, part_cut, churn_cut;
+
+  std::vector<uint32_t> view, timer;                    // [N]
+  std::vector<uint8_t> pp_seen, prepared, committed;    // [N*S]
+  std::vector<uint32_t> pp_view, pp_val, dval;          // [N*S]
+  Net net;
+
+  size_t at(uint32_t n, uint32_t s) const { return size_t(n) * S + s; }
+  bool honest(uint32_t i) const { return i < N - n_byz; }
+
+  void run() {
+    view.assign(N, 0); timer.assign(N, 0);
+    pp_seen.assign(size_t(N) * S, 0); prepared.assign(size_t(N) * S, 0);
+    committed.assign(size_t(N) * S, 0);
+    pp_view.assign(size_t(N) * S, 0); pp_val.assign(size_t(N) * S, 0);
+    dval.assign(size_t(N) * S, 0);
+    const uint32_t Q = 2 * f + 1;
+
+    std::vector<uint8_t> reset(N), new_commit(N);
+    std::vector<uint32_t> views_in;  // for the f+1 rule
+    // Phase snapshots.
+    std::vector<uint32_t> s_view(N);
+    std::vector<uint8_t> s_ppb;      // [N*S] pre-prepare broadcast set
+    std::vector<uint32_t> s_msgval;  // [N*S]
+    std::vector<uint8_t> s_seen, s_prep, s_comm;
+    std::vector<uint32_t> s_val, s_dval;
+
+    for (uint32_t r = 0; r < R; ++r) {
+      net.begin_round(seed, N, r, drop_cut, part_cut);
+      std::fill(reset.begin(), reset.end(), 0);
+      std::fill(new_commit.begin(), new_commit.end(), 0);
+
+      // P0 churn.
+      if (churn_fires(seed, r, churn_cut))
+        for (uint32_t i = 0; i < N; ++i) {
+          view[i] += 1; timer[i] = 0; reset[i] = 1;
+        }
+
+      // P1 view catch-up ((f+1)-th largest delivered honest view ∪ own).
+      s_view = view;
+      for (uint32_t j = 0; j < N; ++j) {
+        views_in.clear();
+        views_in.push_back(s_view[j]);
+        for (uint32_t i = 0; i < N; ++i)
+          if (i != j && honest(i) && net.delivered(i, j))
+            views_in.push_back(s_view[i]);
+        if (views_in.size() >= f + 1) {
+          std::nth_element(views_in.begin(), views_in.begin() + f,
+                           views_in.end(), std::greater<uint32_t>());
+          uint32_t vth = views_in[f];
+          if (vth > view[j]) { view[j] = vth; timer[j] = 0; reset[j] = 1; }
+        }
+      }
+
+      // P2 timeout.
+      for (uint32_t j = 0; j < N; ++j)
+        if (timer[j] >= view_timeout) {
+          view[j] += 1; timer[j] = 0; reset[j] = 1;
+        }
+
+      // P3 pre-prepare. Snapshot sender state (post-P2).
+      s_view = view;
+      s_ppb.assign(size_t(N) * S, 0);
+      s_msgval.assign(size_t(N) * S, 0);
+      for (uint32_t i = 0; i < N; ++i) {
+        if (!honest(i) || s_view[i] % N != i) continue;
+        uint32_t fresh = S;
+        for (uint32_t s = 0; s < S; ++s)
+          if (!pp_seen[at(i, s)]) { fresh = s; break; }
+        for (uint32_t s = 0; s < S; ++s) {
+          bool reissue = pp_seen[at(i, s)] && !committed[at(i, s)];
+          if (reissue || s == fresh) {
+            s_ppb[at(i, s)] = 1;
+            s_msgval[at(i, s)] = pp_seen[at(i, s)]
+                ? pp_val[at(i, s)]
+                : random_u32(seed, STREAM_VALUE, s_view[i], 2, s);
+          }
+        }
+      }
+      for (uint32_t j = 0; j < N; ++j) {
+        uint32_t prim = view[j] % N;
+        bool ok = (prim == j || net.delivered(prim, j)) && s_view[prim] == view[j];
+        if (!ok) continue;
+        for (uint32_t s = 0; s < S; ++s) {
+          if (!s_ppb[at(prim, s)]) continue;
+          uint32_t v = s_msgval[at(prim, s)];
+          if (pp_seen[at(j, s)] && pp_view[at(j, s)] >= view[j]) continue;
+          if (prepared[at(j, s)] && v != pp_val[at(j, s)]) continue;
+          pp_seen[at(j, s)] = 1;
+          pp_view[at(j, s)] = view[j];
+          pp_val[at(j, s)] = v;
+        }
+      }
+
+      // P4 prepare tally (value-matched, incl. self). Snapshot post-P3.
+      s_seen = pp_seen; s_val = pp_val;
+      for (uint32_t j = 0; j < N; ++j)
+        for (uint32_t s = 0; s < S; ++s) {
+          if (!s_seen[at(j, s)] || prepared[at(j, s)]) continue;
+          uint32_t cnt = 0;
+          for (uint32_t i = 0; i < N; ++i)
+            if (honest(i) && s_seen[at(i, s)] &&
+                s_val[at(i, s)] == s_val[at(j, s)] &&
+                (i == j || net.delivered(i, j)))
+              ++cnt;
+          if (cnt >= Q) prepared[at(j, s)] = 1;
+        }
+
+      // P5 commit tally. Snapshot prepared post-P4.
+      s_prep = prepared;
+      for (uint32_t j = 0; j < N; ++j)
+        for (uint32_t s = 0; s < S; ++s) {
+          if (!s_prep[at(j, s)] || committed[at(j, s)]) continue;
+          uint32_t cnt = 0;
+          for (uint32_t i = 0; i < N; ++i)
+            if (honest(i) && s_prep[at(i, s)] &&
+                s_val[at(i, s)] == s_val[at(j, s)] &&
+                (i == j || net.delivered(i, j)))
+              ++cnt;
+          if (cnt >= Q) {
+            committed[at(j, s)] = 1;
+            dval[at(j, s)] = pp_val[at(j, s)];
+            new_commit[j] = 1;
+          }
+        }
+
+      // P6 decide gossip. Snapshot committed post-P5.
+      s_comm = committed; s_dval = dval;
+      for (uint32_t j = 0; j < N; ++j)
+        for (uint32_t s = 0; s < S; ++s) {
+          if (s_comm[at(j, s)]) continue;
+          for (uint32_t i = 0; i < N; ++i)  // ascending ⇒ lowest id wins
+            if (honest(i) && s_comm[at(i, s)] && net.delivered(i, j)) {
+              committed[at(j, s)] = 1;
+              dval[at(j, s)] = s_dval[at(i, s)];
+              new_commit[j] = 1;
+              break;
+            }
+        }
+
+      // P7 timer.
+      for (uint32_t j = 0; j < N; ++j) {
+        if (new_commit[j]) timer[j] = 0;
+        else if (!reset[j]) timer[j] += 1;
+      }
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Multi-decree Paxos (SPEC §5).
+// ---------------------------------------------------------------------------
+
+struct PaxosSim {
+  uint64_t seed;
+  uint32_t N, R, S, P;
+  uint32_t drop_cut, part_cut, churn_cut;
+
+  std::vector<uint32_t> promised, acc_bal, acc_val, learned_val;  // [N*S]
+  std::vector<uint8_t> learned_mask;                              // [N*S]
+  Net net;
+
+  size_t at(uint32_t n, uint32_t s) const { return size_t(n) * S + s; }
+
+  void run() {
+    promised.assign(size_t(N) * S, 0);
+    acc_bal.assign(size_t(N) * S, 0);
+    acc_val.assign(size_t(N) * S, 0);
+    learned_val.assign(size_t(N) * S, 0);
+    learned_mask.assign(size_t(N) * S, 0);
+
+    const uint32_t majority = N / 2 + 1;
+    std::vector<uint32_t> slot(P), bal(P), vown(P), n_prom(P), n_acc(P);
+    std::vector<uint32_t> best_bal(P), best_val(P), v_chosen(P);
+    std::vector<uint8_t> proceed(P), decided(P);
+    // Scratch per acceptor: per-slot max with a touched list (O(P) reset).
+    std::vector<uint32_t> scratch(S, 0);
+    std::vector<uint32_t> touched;
+    touched.reserve(P);
+
+    for (uint32_t r = 0; r < R; ++r) {
+      net.begin_round(seed, N, r, drop_cut, part_cut);
+      const bool churn = churn_fires(seed, r, churn_cut);
+      for (uint32_t p = 0; p < P; ++p) {
+        slot[p] = random_u32(seed, STREAM_VALUE, r, 1, p) % S;
+        bal[p] = r * N + p + 1;
+        vown[p] = random_u32(seed, STREAM_VALUE, r, 0, p);
+        n_prom[p] = n_acc[p] = best_bal[p] = best_val[p] = 0;
+        proceed[p] = decided[p] = 0;
+      }
+      const bool props_active = !churn;
+
+      // Pass 1 per acceptor: prepares → promises; apply new_promised.
+      if (props_active) {
+        for (uint32_t a = 0; a < N; ++a) {
+          touched.clear();
+          for (uint32_t p = 0; p < P; ++p)
+            if (net.delivered(p, a)) {
+              uint32_t s = slot[p];
+              if (scratch[s] == 0) touched.push_back(s);
+              scratch[s] = std::max(scratch[s], bal[p]);
+            }
+          for (uint32_t p = 0; p < P; ++p) {
+            if (!net.delivered(p, a) || !net.delivered(a, p)) continue;
+            uint32_t s = slot[p];
+            // promise iff b > promised_old and b == max(promised_old, P_max)
+            if (bal[p] > promised[at(a, s)] && bal[p] == scratch[s]) {
+              ++n_prom[p];
+              uint32_t rb = acc_bal[at(a, s)];
+              if (rb > best_bal[p]) {  // strict > keeps lowest acceptor id
+                best_bal[p] = rb;
+                best_val[p] = acc_val[at(a, s)];
+              }
+            }
+          }
+          for (uint32_t s : touched) {
+            promised[at(a, s)] = std::max(promised[at(a, s)], scratch[s]);
+            scratch[s] = 0;
+          }
+        }
+      }
+
+      // Proposer gate + value choice.
+      for (uint32_t p = 0; p < P && props_active; ++p) {
+        proceed[p] = n_prom[p] >= majority;
+        v_chosen[p] = best_bal[p] > 0 ? best_val[p] : vown[p];
+      }
+
+      // Pass 2 per acceptor: accepts (reads before writes), responses.
+      if (props_active) {
+        for (uint32_t a = 0; a < N; ++a) {
+          touched.clear();
+          for (uint32_t p = 0; p < P; ++p) {
+            if (!proceed[p] || !net.delivered(p, a)) continue;
+            uint32_t s = slot[p];
+            if (bal[p] >= promised[at(a, s)]) {  // promised == new_promised here
+              if (scratch[s] == 0) touched.push_back(s);
+              scratch[s] = std::max(scratch[s], bal[p]);
+            }
+          }
+          for (uint32_t p = 0; p < P; ++p) {  // responses before application
+            if (!proceed[p] || !net.delivered(p, a) || !net.delivered(a, p))
+              continue;
+            uint32_t s = slot[p];
+            if (bal[p] >= promised[at(a, s)] && bal[p] == scratch[s]) ++n_acc[p];
+          }
+          for (uint32_t s : touched) {
+            uint32_t am = scratch[s];
+            uint32_t pstar = am - (r * N + 1);
+            acc_bal[at(a, s)] = am;
+            acc_val[at(a, s)] = v_chosen[pstar];
+            promised[at(a, s)] = am;
+            scratch[s] = 0;
+          }
+        }
+        for (uint32_t p = 0; p < P; ++p)
+          decided[p] = proceed[p] && n_acc[p] >= majority;
+      }
+
+      // Learn: lowest-id decider per slot, first-learned-wins.
+      for (uint32_t n = 0; n < N; ++n)
+        for (uint32_t p = 0; p < P; ++p) {
+          if (!decided[p]) continue;
+          if (p != n && !net.delivered(p, n)) continue;
+          uint32_t s = slot[p];
+          if (!learned_mask[at(n, s)]) {
+            learned_mask[at(n, s)] = 1;
+            learned_val[at(n, s)] = v_chosen[p];
+          }
+        }
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// DPoS (SPEC §7). O(V) per round: one producer row, no N×N matrix.
+// ---------------------------------------------------------------------------
+
+struct DposSim {
+  uint64_t seed;
+  uint32_t V, R, L, C, K, epoch_len;
+  uint32_t drop_cut, part_cut, churn_cut;
+
+  std::vector<uint32_t> chain_r, chain_p;  // [V*L]
+  std::vector<uint32_t> chain_len;         // [V]
+
+  void run() {
+    chain_r.assign(size_t(V) * L, 0);
+    chain_p.assign(size_t(V) * L, 0);
+    chain_len.assign(V, 0);
+
+    std::vector<uint32_t> stake(V);
+    for (uint32_t v = 0; v < V; ++v)
+      stake[v] = random_u32(seed, STREAM_STAKE, 0, 0, v) % 1000 + 1;
+
+    const uint32_t E = (R + epoch_len - 1) / epoch_len;
+    std::vector<uint32_t> producers(size_t(E) * K);
+    std::vector<uint64_t> tally(C);
+    std::vector<uint32_t> order(C);
+    for (uint32_t e = 0; e < E; ++e) {
+      std::fill(tally.begin(), tally.end(), 0);
+      for (uint32_t v = 0; v < V; ++v)
+        tally[random_u32(seed, STREAM_VOTE, e, 0, v) % C] += stake[v];
+      for (uint32_t c = 0; c < C; ++c) order[c] = c;
+      std::stable_sort(order.begin(), order.end(),
+                       [&](uint32_t a, uint32_t b) { return tally[a] > tally[b]; });
+      for (uint32_t k = 0; k < K; ++k) producers[size_t(e) * K + k] = order[k];
+    }
+
+    for (uint32_t r = 0; r < R; ++r) {
+      if (churn_fires(seed, r, churn_cut)) continue;  // producer offline
+      uint32_t e = r / epoch_len, t = r % epoch_len;
+      uint32_t p = producers[size_t(e) * K + t % K];
+      bool part_active = random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
+      uint32_t side_p = random_u32(seed, STREAM_PARTITION, r, 1, p) & 1u;
+      for (uint32_t v = 0; v < V; ++v) {
+        bool recv;
+        if (v == p) {
+          recv = true;
+        } else {
+          recv = random_u32(seed, STREAM_DELIVER, r, p, v) >= drop_cut;
+          if (recv && part_active)
+            recv = (random_u32(seed, STREAM_PARTITION, r, 1, v) & 1u) == side_p;
+        }
+        if (recv && chain_len[v] < L) {
+          chain_r[size_t(v) * L + chain_len[v]] = r;
+          chain_p[size_t(v) * L + chain_len[v]] = p;
+          chain_len[v] += 1;
+        }
+      }
+    }
+  }
+};
+
 }  // namespace
 }  // namespace ctpu
 
@@ -297,6 +638,71 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
               sizeof(uint32_t) * size_t(n_nodes) * log_capacity);
   std::memcpy(out_term, sim.term.data(), sizeof(uint32_t) * n_nodes);
   std::memcpy(out_role, sim.role.data(), sizeof(uint32_t) * n_nodes);
+  return 0;
+}
+
+int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                  uint32_t n_slots, uint32_t f, uint32_t view_timeout,
+                  uint32_t n_byzantine,
+                  uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
+                  uint8_t* out_committed,   // [N*S]
+                  uint32_t* out_dval,       // [N*S]
+                  uint32_t* out_view) {     // [N]
+  if (n_nodes != 3 * f + 1 || n_byzantine > f) return 1;
+  ctpu::PbftSim sim;
+  sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
+  sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
+  sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.run();
+  size_t ns = size_t(n_nodes) * n_slots;
+  std::memcpy(out_committed, sim.committed.data(), ns);
+  std::memcpy(out_dval, sim.dval.data(), sizeof(uint32_t) * ns);
+  std::memcpy(out_view, sim.view.data(), sizeof(uint32_t) * n_nodes);
+  return 0;
+}
+
+int ctpu_paxos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                   uint32_t n_slots, uint32_t n_proposers,
+                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
+                   uint32_t* out_learned_val,   // [N*S]
+                   uint8_t* out_learned_mask,   // [N*S]
+                   uint32_t* out_promised,      // [N*S]
+                   uint32_t* out_acc_bal,       // [N*S]
+                   uint32_t* out_acc_val) {     // [N*S]
+  if (n_nodes == 0 || n_slots == 0) return 1;
+  ctpu::PaxosSim sim;
+  sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
+  sim.P = n_proposers ? n_proposers : n_nodes;
+  sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.run();
+  size_t ns = size_t(n_nodes) * n_slots;
+  std::memcpy(out_learned_val, sim.learned_val.data(), sizeof(uint32_t) * ns);
+  std::memcpy(out_learned_mask, sim.learned_mask.data(), ns);
+  std::memcpy(out_promised, sim.promised.data(), sizeof(uint32_t) * ns);
+  std::memcpy(out_acc_bal, sim.acc_bal.data(), sizeof(uint32_t) * ns);
+  std::memcpy(out_acc_val, sim.acc_val.data(), sizeof(uint32_t) * ns);
+  return 0;
+}
+
+int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                  uint32_t log_capacity, uint32_t n_candidates,
+                  uint32_t n_producers, uint32_t epoch_len,
+                  uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
+                  uint32_t* out_chain_r,    // [V*L]
+                  uint32_t* out_chain_p,    // [V*L]
+                  uint32_t* out_chain_len) {  // [V]
+  if (n_nodes == 0 || n_candidates == 0 || n_producers == 0 ||
+      n_producers > n_candidates || n_candidates > n_nodes || epoch_len == 0)
+    return 1;
+  ctpu::DposSim sim;
+  sim.seed = seed; sim.V = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
+  sim.C = n_candidates; sim.K = n_producers; sim.epoch_len = epoch_len;
+  sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.run();
+  size_t vl = size_t(n_nodes) * log_capacity;
+  std::memcpy(out_chain_r, sim.chain_r.data(), sizeof(uint32_t) * vl);
+  std::memcpy(out_chain_p, sim.chain_p.data(), sizeof(uint32_t) * vl);
+  std::memcpy(out_chain_len, sim.chain_len.data(), sizeof(uint32_t) * n_nodes);
   return 0;
 }
 

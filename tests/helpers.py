@@ -1,0 +1,11 @@
+"""Shared test helpers: cache simulator runs so differential and invariant
+tests over the same Config don't recompute (and skip the timing warmup —
+tests assert on decided logs, not steady-state throughput)."""
+import functools
+
+from consensus_tpu.network import simulator
+
+
+@functools.lru_cache(maxsize=None)
+def run_cached(cfg):
+    return simulator.run(cfg, warmup=False)

@@ -13,6 +13,8 @@ import pytest
 from consensus_tpu import Config
 from consensus_tpu.network import simulator
 
+from helpers import run_cached
+
 CLEAN = Config(protocol="raft", n_nodes=5, n_rounds=64, log_capacity=128,
                max_entries=100, n_sweeps=2, seed=7)
 ADVERSARIAL = [
@@ -26,19 +28,19 @@ ADVERSARIAL = [
 
 @pytest.mark.parametrize("cfg", [CLEAN] + ADVERSARIAL)
 def test_raft_decided_log_byte_equivalence(cfg):
-    tpu = simulator.run(dataclasses.replace(cfg, engine="tpu"))
-    cpu = simulator.run(dataclasses.replace(cfg, engine="cpu"))
+    tpu = run_cached(dataclasses.replace(cfg, engine="tpu"))
+    cpu = run_cached(dataclasses.replace(cfg, engine="cpu"))
     assert tpu.digest == cpu.digest
     assert tpu.payload == cpu.payload
 
 
 def test_raft_makes_progress_clean():
-    res = simulator.run(dataclasses.replace(CLEAN, engine="tpu"))
+    res = run_cached(dataclasses.replace(CLEAN, engine="tpu"))
     # A clean 64-round run must elect a leader and commit a healthy log.
     assert res.counts.max() >= 40
 
 
 def test_raft_rerun_bitwise_deterministic():
-    a = simulator.run(dataclasses.replace(CLEAN, engine="tpu"))
-    b = simulator.run(dataclasses.replace(CLEAN, engine="tpu"))
+    a = run_cached(dataclasses.replace(CLEAN, engine="tpu"))
+    b = run_cached(dataclasses.replace(CLEAN, engine="tpu"))
     assert a.payload == b.payload

@@ -8,6 +8,8 @@ import pytest
 from consensus_tpu import Config
 from consensus_tpu.network import simulator
 
+from helpers import run_cached
+
 CFGS = [
     Config(protocol="raft", n_nodes=5, n_rounds=96, log_capacity=128,
            max_entries=100, n_sweeps=6, seed=101,
@@ -21,7 +23,7 @@ CFGS = [
 @pytest.mark.parametrize("cfg", CFGS)
 def test_state_machine_safety(cfg):
     """All nodes' committed prefixes agree (same (term, val) at same index)."""
-    res = simulator.run(cfg)
+    res = run_cached(cfg)
     for b in range(cfg.n_sweeps):
         counts = res.counts[b]
         for i in range(cfg.n_nodes):
