@@ -1,0 +1,85 @@
+"""Driver benchmark: one JSON line on stdout.
+
+Flagship config: the Raft 1k-node × 1k-round batched log-match sweep
+(BASELINE.md config 2) on the real TPU chip. Metric is
+node-round-steps/sec (BASELINE.json:2); ``vs_baseline`` is the ratio
+against the driver's north-star target of 10M steps/sec/chip
+(BASELINE.json:5 — the reference publishes no numbers of its own,
+BASELINE.json:13, so the target is the only defined baseline).
+
+Usage: python bench.py [--nodes N] [--rounds R] [--sweeps B] [--json-only]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+NORTH_STAR_STEPS_PER_SEC = 10_000_000.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1024)
+    ap.add_argument("--rounds", type=int, default=1024)
+    ap.add_argument("--sweeps", type=int, default=8)
+    ap.add_argument("--log-capacity", type=int, default=128)
+    ap.add_argument("--drop-rate", type=float, default=0.01)
+    ap.add_argument("--churn-rate", type=float, default=0.001)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+    args.repeats = max(1, args.repeats)
+
+    import jax
+
+    from consensus_tpu.core.config import Config
+    from consensus_tpu.engines.raft import raft_run
+
+    dev = jax.devices()[0]
+    print(f"bench: device={dev}, platform={dev.platform}", file=sys.stderr)
+
+    cfg = Config(
+        protocol="raft", engine="tpu",
+        n_nodes=args.nodes, n_rounds=args.rounds, n_sweeps=args.sweeps,
+        log_capacity=args.log_capacity,
+        max_entries=max(1, args.log_capacity - 16),
+        drop_rate=args.drop_rate, churn_rate=args.churn_rate, seed=42,
+    )
+    steps = cfg.n_sweeps * cfg.n_nodes * cfg.n_rounds
+
+    t0 = time.perf_counter()
+    raft_run(cfg)  # compile + warm up
+    print(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    best = float("inf")
+    for i in range(args.repeats):
+        t0 = time.perf_counter()
+        out = raft_run(cfg)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        print(f"bench: run {i}: {dt:.3f}s = {steps / dt / 1e6:.2f}M steps/s",
+              file=sys.stderr)
+
+    # Sanity: the simulation must actually decide entries, or the number
+    # is meaningless — fail loudly rather than report idle throughput.
+    committed = int(out["commit"].max())
+    print(f"bench: max committed entries = {committed}", file=sys.stderr)
+    if committed == 0:
+        print("bench: FAILED — nothing committed; config is degenerate",
+              file=sys.stderr)
+        sys.exit(1)
+
+    value = steps / best
+    print(json.dumps({
+        "metric": "raft-1k-node-1k-round node-round-steps/sec",
+        "value": round(value, 1),
+        "unit": "steps/sec",
+        "vs_baseline": round(value / NORTH_STAR_STEPS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

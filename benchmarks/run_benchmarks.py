@@ -1,0 +1,156 @@
+"""Benchmark suite over the five BASELINE.md configs.
+
+Measures node-round-steps/sec for the TPU engine and (where tractable)
+the single-core C++ oracle, producing the oracle baseline BASELINE.md
+calls for ("First measurement milestone") plus the TPU speedup.
+
+Writes benchmarks/RESULTS.json and prints a table. Run on the TPU chip:
+
+    python benchmarks/run_benchmarks.py [--quick] [--skip-oracle]
+
+The oracle is O(N^2) per round in delivery queries, so for the two giant
+configs (Paxos 10k x 10k, Raft 1k x 1k) the oracle is measured on a
+scaled-down config and reported as-is (scaling is linear in B*R and
+quadratic in N; the JSON records the exact config measured — no
+extrapolated numbers are reported as measurements).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from consensus_tpu.core.config import Config  # noqa: E402
+
+ADV = dict(drop_rate=0.01, churn_rate=0.001)
+
+# The five BASELINE.json configs (B:6-12), sized for the TPU engine.
+CONFIGS = {
+    # 1. Raft 5-node leader election + 100-entry replication. Tiny per
+    # instance — batched 512 sweeps wide to give the chip actual work.
+    "raft-5node": Config(protocol="raft", n_nodes=5, n_rounds=160,
+                         n_sweeps=512, log_capacity=128, max_entries=100,
+                         seed=1, **ADV),
+    # 2. Raft 1k-node x 1k-round batched log-match sweep.
+    "raft-1kx1k": Config(protocol="raft", n_nodes=1024, n_rounds=1024,
+                         n_sweeps=8, log_capacity=128, max_entries=100,
+                         seed=2, **ADV),
+    # 3. PBFT f-sweep: shapes differ per f (N = 3f+1), so each f compiles
+    # its own program; report the aggregate. Full 1..128 sweep is hours of
+    # compiles — benchmark the power-of-two ladder.
+    # (handled specially below)
+    # 4. Multi-decree Paxos 10k acceptors x 10k slots.
+    "paxos-10kx10k": Config(protocol="paxos", n_nodes=10_000, n_rounds=16,
+                            n_sweeps=1, log_capacity=10_000, seed=4, **ADV),
+    # 5. DPoS 100k validators x epoch schedule.
+    "dpos-100k": Config(protocol="dpos", n_nodes=100_000, n_rounds=256,
+                        n_sweeps=1, log_capacity=256, n_candidates=1024,
+                        n_producers=21, epoch_len=32, seed=5, **ADV),
+}
+
+PBFT_FS = [1, 2, 4, 8, 16, 32, 64, 128]
+
+# Oracle-sized variants for the configs whose full size is intractable on
+# one CPU core (O(N^2) delivery per round).
+ORACLE_SIZED = {
+    "raft-5node": dataclasses.replace(CONFIGS["raft-5node"], n_sweeps=8),
+    "raft-1kx1k": dataclasses.replace(CONFIGS["raft-1kx1k"], n_sweeps=1,
+                                      n_rounds=32),
+    "paxos-10kx10k": dataclasses.replace(CONFIGS["paxos-10kx10k"],
+                                         n_nodes=1000, log_capacity=1000,
+                                         n_rounds=8),
+    "dpos-100k": dataclasses.replace(CONFIGS["dpos-100k"], n_rounds=64),
+}
+
+
+def time_tpu(cfg: Config, repeats: int = 3) -> dict:
+    from consensus_tpu.network import simulator
+    simulator.run(cfg, warmup=False)  # compile
+    best = None
+    for _ in range(repeats):
+        r = simulator.run(cfg, warmup=False)
+        if best is None or r.wall_s < best.wall_s:
+            best = r
+    return {"engine": "tpu", "config": json.loads(cfg.to_json()),
+            "steps": best.node_round_steps, "wall_s": best.wall_s,
+            "steps_per_sec": best.steps_per_sec, "digest": best.digest}
+
+
+def time_oracle(cfg: Config, repeats: int = 2) -> dict:
+    from consensus_tpu.network import simulator
+    cfg = dataclasses.replace(cfg, engine="cpu")
+    best = None
+    for _ in range(repeats):
+        r = simulator.run(cfg)
+        if best is None or r.wall_s < best.wall_s:
+            best = r
+    return {"engine": "cpu-oracle", "config": json.loads(cfg.to_json()),
+            "steps": best.node_round_steps, "wall_s": best.wall_s,
+            "steps_per_sec": best.steps_per_sec, "digest": best.digest}
+
+
+def bench_pbft_sweep(fs, quick: bool, skip_oracle: bool) -> list[dict]:
+    out = []
+    for f in fs:
+        cfg = Config(protocol="pbft", f=f, n_nodes=3 * f + 1, n_rounds=32,
+                     n_sweeps=4 if f <= 16 else 1, log_capacity=32,
+                     seed=3, **ADV)
+        row = {"name": f"pbft-f{f}", "tpu": time_tpu(cfg, repeats=2)}
+        if not skip_oracle and (f <= 32 or not quick):
+            row["oracle"] = time_oracle(cfg, repeats=1)
+        out.append(row)
+        _progress(row)
+    return out
+
+
+def _progress(row: dict) -> None:
+    t = row.get("tpu", {}).get("steps_per_sec", 0)
+    o = row.get("oracle", {}).get("steps_per_sec", 0)
+    speed = f" speedup={t / o:.1f}x" if o else ""
+    print(f"  {row['name']:16s} tpu={t / 1e6:8.2f}M/s"
+          + (f" oracle={o / 1e6:6.2f}M/s{speed}" if o else ""),
+          file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small pbft ladder, fewer repeats")
+    ap.add_argument("--skip-oracle", action="store_true")
+    ap.add_argument("--only", default="",
+                    help="comma-separated subset of config names")
+    args = ap.parse_args()
+
+    import jax
+    dev = jax.devices()[0]
+    print(f"benchmarks: device={dev} platform={dev.platform}", file=sys.stderr)
+
+    results = {"device": str(dev), "platform": dev.platform,
+               "timestamp": time.time(), "rows": []}
+    only = set(args.only.split(",")) if args.only else None
+
+    for name, cfg in CONFIGS.items():
+        if only and name not in only:
+            continue
+        row = {"name": name, "tpu": time_tpu(cfg)}
+        if not args.skip_oracle:
+            row["oracle"] = time_oracle(ORACLE_SIZED.get(name, cfg))
+        results["rows"].append(row)
+        _progress(row)
+
+    if not only or any(n.startswith("pbft") for n in only):
+        fs = PBFT_FS[:4] if args.quick else PBFT_FS
+        results["rows"] += bench_pbft_sweep(fs, args.quick, args.skip_oracle)
+
+    out_path = pathlib.Path(__file__).parent / "RESULTS.json"
+    out_path.write_text(json.dumps(results, indent=2))
+    print(f"wrote {out_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

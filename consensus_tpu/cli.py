@@ -1,0 +1,134 @@
+"""Python CLI — same flag surface as the native `cpp/consensus-sim` binary.
+
+`consensus-sim --engine tpu ...` execs into this module, so both engines
+are driven through one front door (SURVEY.md §2 component 13). Emits the
+same JSON report shape as the native CLI; `digest` values are comparable
+across engines because both serialize through the canonical decided-log
+spec (docs/SPEC.md §4).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+# flag name -> (Config field, default). Precedence: defaults < --config
+# file < flags the user actually typed (argparse SUPPRESS tells us which).
+_FLAG_FIELDS = {
+    "protocol": ("protocol", "raft"),
+    "engine": ("engine", "tpu"),
+    "nodes": ("n_nodes", None),       # None ⇒ protocol-dependent default
+    "rounds": ("n_rounds", 64),
+    "sweeps": ("n_sweeps", 1),
+    "seed": ("seed", 0),
+    "log_capacity": ("log_capacity", 128),
+    "max_entries": ("max_entries", 100),
+    "t_min": ("t_min", 3),
+    "t_max": ("t_max", 8),
+    "drop_rate": ("drop_rate", 0.0),
+    "partition_rate": ("partition_rate", 0.0),
+    "churn_rate": ("churn_rate", 0.0),
+    "f": ("f", 1),
+    "view_timeout": ("view_timeout", 8),
+    "n_byzantine": ("n_byzantine", 0),
+    "n_proposers": ("n_proposers", 0),
+    "candidates": ("n_candidates", 16),
+    "producers": ("n_producers", 4),
+    "epoch_len": ("epoch_len", 16),
+    "scan_chunk": ("scan_chunk", 0),
+}
+_FLAG_TYPES = {"protocol": str, "engine": str, "drop_rate": float,
+               "partition_rate": float, "churn_rate": float}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # Config-field flags default to SUPPRESS so args_to_config can tell
+    # "user typed --rounds 64" from "argparse default 64" — only typed
+    # flags may override a --config file (the review's precedence bug).
+    ap = argparse.ArgumentParser(prog="consensus-sim")
+    for flag, (_, _default) in _FLAG_FIELDS.items():
+        typ = _FLAG_TYPES.get(flag, int)
+        kw = dict(type=typ, default=argparse.SUPPRESS)
+        if flag == "protocol":
+            kw["choices"] = ["raft", "pbft", "paxos", "dpos"]
+        if flag == "engine":
+            kw["choices"] = ["cpu", "tpu"]
+        ap.add_argument("--" + flag.replace("_", "-"), **kw)
+    ap.add_argument("--mesh", default=argparse.SUPPRESS,
+                    help="device mesh, e.g. '8' (sweep-parallel) or '2x4' "
+                         "(sweep x node); TPU engine only")
+    ap.add_argument("--checkpoint", default="",
+                    help="checkpoint file; resumes from it if present")
+    ap.add_argument("--out", default="", help="dump raw payload bytes")
+    ap.add_argument("--profile", default="",
+                    help="write a jax.profiler trace to this directory "
+                         "(TPU engine only)")
+    ap.add_argument("--config", default="",
+                    help="JSON config file; typed flags override its values")
+    return ap
+
+
+def args_to_config(args):
+    import dataclasses
+
+    from .core.config import Config
+
+    fields = {}
+    if getattr(args, "config", ""):
+        with open(args.config) as fp:
+            # from_json filters unknown keys and normalizes mesh_shape.
+            fields = dataclasses.asdict(Config.from_json(fp.read()))
+    given = vars(args)
+    for flag, (field, default) in _FLAG_FIELDS.items():
+        if flag in given:
+            fields[field] = given[flag]
+        elif field not in fields and default is not None:
+            fields[field] = default
+    if "mesh" in given:
+        fields["mesh_shape"] = tuple(int(x) for x in given["mesh"].split("x"))
+    elif "mesh_shape" in fields:
+        fields["mesh_shape"] = tuple(fields["mesh_shape"])
+    if fields.get("n_nodes") is None:
+        fields["n_nodes"] = 3 * fields["f"] + 1 \
+            if fields["protocol"] == "pbft" else 5
+    return Config(**fields)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = args_to_config(args)
+
+    from .network import simulator
+
+    run_kw = {}
+    if args.checkpoint:
+        run_kw = dict(checkpoint_path=args.checkpoint, resume=True)
+
+    if args.profile and cfg.engine == "tpu":
+        import jax
+        with jax.profiler.trace(args.profile):
+            result = simulator.run(cfg, **run_kw)
+        print(f"profile trace written to {args.profile}", file=sys.stderr)
+    else:
+        result = simulator.run(cfg, **run_kw)
+
+    if args.out:
+        with open(args.out, "wb") as f:
+            f.write(result.payload)
+
+    print(json.dumps({
+        "protocol": cfg.protocol, "engine": cfg.engine,
+        "n_nodes": cfg.n_nodes, "n_rounds": cfg.n_rounds,
+        "n_sweeps": cfg.n_sweeps, "seed": cfg.seed,
+        "steps": result.node_round_steps,
+        "wall_s": round(result.wall_s, 6),
+        "steps_per_sec": round(result.steps_per_sec, 1),
+        "payload_bytes": len(result.payload),
+        "digest": result.digest,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

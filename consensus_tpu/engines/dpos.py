@@ -84,33 +84,50 @@ def dpos_round(cfg: Config, producers, st: DposState, r) -> DposState:
     return DposState(seed, chain_r, chain_p, chain_len)
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _dpos_run_jit(cfg: Config, seeds):
-    def one(seed):
-        _, producers, _ = dpos_schedule(cfg, seed)
-        V, L = cfg.n_nodes, cfg.log_capacity
-        st0 = DposState(jnp.asarray(seed, jnp.uint32),
-                        jnp.zeros((V, L), jnp.int32),
-                        jnp.zeros((V, L), jnp.int32),
-                        jnp.zeros(V, jnp.int32))
-        rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
-
-        def body(st, r):
-            return dpos_round(cfg, producers, st, r), None
-
-        stF, _ = jax.lax.scan(body, st0, rounds)
-        return stF
-
-    return jax.vmap(one)(seeds)
+def dpos_make_carry(cfg: Config, seed):
+    """Carry = (per-epoch producer schedule, chain state). The schedule is
+    computed once from the seed and rides the scan carry unchanged."""
+    _, producers, _ = dpos_schedule(cfg, seed)
+    V, L = cfg.n_nodes, cfg.log_capacity
+    st0 = DposState(jnp.asarray(seed, jnp.uint32),
+                    jnp.zeros((V, L), jnp.int32),
+                    jnp.zeros((V, L), jnp.int32),
+                    jnp.zeros(V, jnp.int32))
+    return producers, st0
 
 
-def dpos_run(cfg: Config):
-    B = cfg.n_sweeps
-    seeds = ((np.uint64(cfg.seed) + np.arange(B, dtype=np.uint64))
-             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    stF = _dpos_run_jit(cfg, seeds)
-    return {
-        "chain_r": np.asarray(stF.chain_r),
-        "chain_p": np.asarray(stF.chain_p),
-        "chain_len": np.asarray(stF.chain_len),
-    }
+def dpos_round_carry(cfg: Config, carry, r):
+    producers, st = carry
+    return producers, dpos_round(cfg, producers, st, r)
+
+
+def _dpos_extract(carry) -> dict:
+    _, st = carry
+    return {"chain_r": st.chain_r, "chain_p": st.chain_p,
+            "chain_len": st.chain_len}
+
+
+def _dpos_pspec(cfg: Config):
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import NODE_AXIS as ND
+    # The [E, K] schedule is replicated; chain state shards over validators.
+    return (P(None, None),
+            DposState(seed=P(), chain_r=P(ND, None), chain_p=P(ND, None),
+                      chain_len=P(ND)))
+
+
+_ENGINE = None
+
+
+def get_engine():
+    global _ENGINE
+    if _ENGINE is None:
+        from ..network.runner import EngineDef
+        _ENGINE = EngineDef("dpos", dpos_make_carry, dpos_round_carry,
+                            _dpos_extract, _dpos_pspec)
+    return _ENGINE
+
+
+def dpos_run(cfg: Config, **kw):
+    from ..network import runner
+    return runner.run(cfg, get_engine(), **kw)

@@ -117,27 +117,32 @@ def paxos_round(cfg: Config, st: PaxosState, r) -> PaxosState:
     return PaxosState(seed, promised2, acc_bal2, acc_val2, learned_val, learned_mask)
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _paxos_run_jit(cfg: Config, seeds):
-    st0 = jax.vmap(lambda s: paxos_init(cfg, s))(seeds)
-    rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
-
-    def scan_body(sts, r):
-        return jax.vmap(lambda s: paxos_round(cfg, s, r))(sts), None
-
-    stF, _ = jax.lax.scan(scan_body, st0, rounds)
-    return stF
+def _paxos_extract(st: PaxosState) -> dict:
+    return {"learned_mask": st.learned_mask, "learned_val": st.learned_val,
+            "promised": st.promised, "acc_bal": st.acc_bal,
+            "acc_val": st.acc_val}
 
 
-def paxos_run(cfg: Config):
-    B = cfg.n_sweeps
-    seeds = ((np.uint64(cfg.seed) + np.arange(B, dtype=np.uint64))
-             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    stF = _paxos_run_jit(cfg, seeds)
-    return {
-        "learned_mask": np.asarray(stF.learned_mask),
-        "learned_val": np.asarray(stF.learned_val),
-        "promised": np.asarray(stF.promised),
-        "acc_bal": np.asarray(stF.acc_bal),
-        "acc_val": np.asarray(stF.acc_val),
-    }
+def _paxos_pspec(cfg: Config) -> PaxosState:
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import NODE_AXIS as ND
+    m = P(ND, None)
+    return PaxosState(seed=P(), promised=m, acc_bal=m, acc_val=m,
+                      learned_val=m, learned_mask=m)
+
+
+_ENGINE = None
+
+
+def get_engine():
+    global _ENGINE
+    if _ENGINE is None:
+        from ..network.runner import EngineDef
+        _ENGINE = EngineDef("paxos", paxos_init, paxos_round, _paxos_extract,
+                            _paxos_pspec)
+    return _ENGINE
+
+
+def paxos_run(cfg: Config, **kw):
+    from ..network import runner
+    return runner.run(cfg, get_engine(), **kw)

@@ -135,28 +135,31 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
                      prepared, committed, dval)
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _pbft_run_jit(cfg: Config, seeds):
-    st0 = jax.vmap(lambda s: pbft_init(cfg, s))(seeds)
-    rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
-
-    def scan_body(sts, r):
-        return jax.vmap(lambda s: pbft_round(cfg, s, r))(sts), None
-
-    stF, _ = jax.lax.scan(scan_body, st0, rounds)
-    return stF
+def _pbft_extract(st: PbftState) -> dict:
+    return {"committed": st.committed, "dval": st.dval, "view": st.view,
+            "prepared": st.prepared, "pp_val": st.pp_val, "pp_seen": st.pp_seen}
 
 
-def pbft_run(cfg: Config):
-    B = cfg.n_sweeps
-    seeds = ((np.uint64(cfg.seed) + np.arange(B, dtype=np.uint64))
-             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    stF = _pbft_run_jit(cfg, seeds)
-    return {
-        "committed": np.asarray(stF.committed),
-        "dval": np.asarray(stF.dval),
-        "view": np.asarray(stF.view),
-        "prepared": np.asarray(stF.prepared),
-        "pp_val": np.asarray(stF.pp_val),
-        "pp_seen": np.asarray(stF.pp_seen),
-    }
+def _pbft_pspec(cfg: Config) -> PbftState:
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import NODE_AXIS as ND
+    v, m = P(ND), P(ND, None)
+    return PbftState(seed=P(), view=v, timer=v, pp_seen=m, pp_view=m,
+                     pp_val=m, prepared=m, committed=m, dval=m)
+
+
+_ENGINE = None
+
+
+def get_engine():
+    global _ENGINE
+    if _ENGINE is None:
+        from ..network.runner import EngineDef
+        _ENGINE = EngineDef("pbft", pbft_init, pbft_round, _pbft_extract,
+                            _pbft_pspec)
+    return _ENGINE
+
+
+def pbft_run(cfg: Config, **kw):
+    from ..network import runner
+    return runner.run(cfg, get_engine(), **kw)

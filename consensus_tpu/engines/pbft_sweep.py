@@ -1,0 +1,184 @@
+"""Batched PBFT f-sweep: f = 1..128 as a batch axis of ONE XLA program.
+
+The reference runs its `pbft::quorum` f-sweep [B:9] as one process per f
+(each with N = 3f+1 nodes). A naive TPU port would compile 128 separate
+programs (shapes differ per f) — ~an hour of XLA compiles for seconds of
+execution. Instead, the TPU-native design pads every sweep element to
+N_pad = 3·f_max+1 nodes and makes (n_real, f) *traced per-sweep scalars*:
+
+  * padded nodes are never honest senders, never delivered to/from, and
+    are sliced off before serialization — and because every RNG draw is
+    keyed by absolute ids (round, edge i→j, node), not by N (docs/SPEC.md
+    §1-2), the draws real nodes see are IDENTICAL to the unpadded
+    engine's. Byte-equivalence with the per-f C++ oracle runs is tested
+    in tests/test_pbft_sweep.py.
+  * quorum threshold Q = 2f+1 and primary = view mod n_real use the
+    traced scalars, so one compiled kernel serves every f.
+
+Cost: ~3.4x the FLOPs of the exact per-f sum (padding waste), repaid
+>100x over in avoided compiles; the whole sweep runs as one `vmap` under
+one `lax.scan`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.config import Config
+from ..ops.adversary import churn as churn_draw
+from ..ops.adversary import cutoff as _lt
+from ..ops.adversary import delivery as _delivery
+from ..ops.adversary import draw as _draw
+from ..ops.adversary import bitcast_i32 as _i32
+from .pbft import PbftState
+
+
+def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
+    """One SPEC §6 round on a padded population.
+
+    ``cfg.n_nodes`` is the padded size N_pad (static); ``n_real`` = 3f+1
+    and ``f`` are traced i32 scalars. Mirrors engines/pbft.py phase by
+    phase; the only deltas are the padding mask and the traced Q/primary.
+    """
+    N, S = cfg.n_nodes, cfg.log_capacity
+    Q = 2 * f + 1
+    seed = st.seed
+    ur = jnp.asarray(r, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    sarange = jnp.arange(S, dtype=jnp.int32)
+    real = idx < n_real
+
+    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    deliver = deliver & real[:, None] & real[None, :]
+    churn = churn_draw(seed, ur, cfg.churn_cutoff)
+    honest = idx < (n_real - cfg.n_byzantine)
+    d_h = deliver & honest[:, None]
+    d_self_h = (deliver | jnp.eye(N, dtype=bool)) & honest[:, None]
+
+    view, timer = st.view, st.timer
+    pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
+    prepared, committed, dval = st.prepared, st.committed, st.dval
+    committed_at_start = committed
+
+    # ---- P0 churn: synchronized view bump.
+    view = view + churn.astype(jnp.int32)
+    timer = jnp.where(churn, 0, timer)
+    reset = jnp.broadcast_to(churn, (N,))
+
+    # ---- P1 view catch-up: (f+1)-th largest delivered honest view ∪ own.
+    w = jnp.where(d_h, view[:, None], -1)
+    w = jnp.where(jnp.eye(N, dtype=bool), view[None, :], w)
+    # (f+1)-th largest with traced f: index N-1-f of the ascending sort.
+    # Padded senders contribute -1, which sorts low; f < n_real <= N keeps
+    # the index inside the real entries.
+    vth = jnp.take(jnp.sort(w, axis=0), N - 1 - f, axis=0)
+    catch = vth > view
+    view = jnp.where(catch, vth, view)
+    timer = jnp.where(catch, 0, timer)
+    reset |= catch
+
+    # ---- P2 timeout.
+    to = timer >= cfg.view_timeout
+    view = view + to.astype(jnp.int32)
+    timer = jnp.where(to, 0, timer)
+    reset |= to
+
+    # ---- P3 pre-prepare.
+    is_primary = honest & (view % n_real == idx)
+    fresh = jnp.min(jnp.where(~pp_seen, sarange[None, :], S), axis=1)
+    fresh_hot = sarange[None, :] == fresh[:, None]
+    ppb = is_primary[:, None] & ((pp_seen & ~committed) | fresh_hot)
+    fresh_val = _i32(_draw(seed, rng.STREAM_VALUE,
+                           view[:, None].astype(jnp.uint32), 2,
+                           sarange[None, :].astype(jnp.uint32)))
+    msg_val = jnp.where(pp_seen, pp_val, fresh_val)
+
+    prim = view % n_real
+    del_self = deliver | jnp.eye(N, dtype=bool)
+    prim_ok = del_self[prim, idx] & (view[prim] == view) & real
+    pm_b = ppb[prim]
+    pm_val = msg_val[prim]
+    accept = (prim_ok[:, None] & pm_b
+              & (~pp_seen | (pp_view < view[:, None]))
+              & (~prepared | (pm_val == pp_val)))
+    pp_view = jnp.where(accept, view[:, None], pp_view)
+    pp_val = jnp.where(accept, pm_val, pp_val)
+    pp_seen = pp_seen | accept
+
+    # ---- P4 prepare tally (value-matched, incl. self).
+    val_eq = pp_val[:, None, :] == pp_val[None, :, :]
+    pcount = jnp.sum(d_self_h[:, :, None] & pp_seen[:, None, :] & val_eq,
+                     axis=0, dtype=jnp.int32)
+    prepared = prepared | (pp_seen & (pcount >= Q))
+
+    # ---- P5 commit tally.
+    ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
+                     axis=0, dtype=jnp.int32)
+    commit_now = prepared & (ccount >= Q) & ~committed
+    dval = jnp.where(commit_now, pp_val, dval)
+    committed = committed | commit_now
+
+    # ---- P6 decide gossip: adopt from lowest-id delivered decider.
+    dec_b = committed & honest[:, None]
+    imin = jnp.min(jnp.where(d_h[:, :, None] & dec_b[:, None, :],
+                             idx[:, None, None], N), axis=0)
+    adopt = (imin < N) & ~committed
+    dval = jnp.where(adopt, dval[jnp.clip(imin, 0, N - 1), sarange[None, :]], dval)
+    committed = committed | adopt
+
+    # ---- P7 timer.
+    new_commit = jnp.any(committed & ~committed_at_start, axis=1)
+    timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
+                      timer + 1)
+
+    return PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
+                     prepared, committed, dval)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _fsweep_jit(cfg: Config, seeds, n_reals, fs):
+    from .pbft import pbft_init
+
+    st0 = jax.vmap(lambda s: pbft_init(cfg, s))(seeds)
+    rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
+
+    def body(sts, r):
+        return jax.vmap(
+            lambda s, n, f: pbft_round_padded(cfg, s, r, n, f)
+        )(sts, n_reals, fs), None
+
+    stF, _ = jax.lax.scan(body, st0, rounds)
+    return stF
+
+
+def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:
+    """Run sweep element k with f = fs[k], seed = cfg.seed + k, all in one
+    compiled program. ``cfg.f`` is ignored; ``cfg.n_nodes`` may be 0 (it
+    is replaced by the padded size). Returns one dict per element with
+    arrays sliced back to that element's real 3f+1 nodes — identical
+    layout to engines.pbft.pbft_run's per-sweep output.
+    """
+    import dataclasses
+
+    fs = [int(f) for f in fs]
+    n_pad = 3 * max(fs) + 1
+    cfg_pad = dataclasses.replace(cfg, protocol="pbft", f=max(fs),
+                                  n_nodes=n_pad, n_sweeps=len(fs))
+    seeds = ((np.uint64(cfg.seed) + np.arange(len(fs), dtype=np.uint64))
+             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    n_reals = jnp.asarray([3 * f + 1 for f in fs], jnp.int32)
+    stF = _fsweep_jit(cfg_pad, jnp.asarray(seeds), n_reals,
+                      jnp.asarray(fs, jnp.int32))
+    out = []
+    for k, f in enumerate(fs):
+        n = 3 * f + 1
+        out.append({
+            "committed": np.asarray(stF.committed[k, :n]),
+            "dval": np.asarray(stF.dval[k, :n]),
+            "view": np.asarray(stF.view[k, :n]),
+        })
+    return out

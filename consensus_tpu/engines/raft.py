@@ -44,17 +44,11 @@ class RaftState(NamedTuple):
     next_idx: jnp.ndarray   # [N, N] i32
 
 
-def _draw(seed, stream, ctx, c0, c1):
-    return rng.random_u32_jnp(seed, stream, ctx, c0, c1)
-
-
-def _lt(cut: int):
-    """u32 cutoff as a jnp constant."""
-    return jnp.uint32(cut)
-
-
-def _i32(x):
-    return jax.lax.bitcast_convert_type(x, jnp.int32)
+# Shared kernels live in ops/ (SURVEY.md §7 package layout); the aliases
+# keep this module's call sites terse and preserve the original seams.
+from ..ops.adversary import bitcast_i32 as _i32
+from ..ops.adversary import cutoff as _lt
+from ..ops.adversary import draw as _draw
 
 
 def _draw_timeout(seed, t_min, t_max, term, idx):
@@ -79,17 +73,7 @@ def raft_init(cfg: Config, seed) -> RaftState:
     )
 
 
-def _delivery(seed, N: int, r, drop_cut: int, part_cut: int):
-    """SPEC §2: [i, j] True iff a message i→j is delivered in round r."""
-    i = jnp.arange(N, dtype=jnp.uint32)[:, None]
-    j = jnp.arange(N, dtype=jnp.uint32)[None, :]
-    dropped = _draw(seed, rng.STREAM_DELIVER, r, i, j) < _lt(drop_cut)
-    part_active = _draw(seed, rng.STREAM_PARTITION, r, 0, 0) < _lt(part_cut)
-    side = (_draw(seed, rng.STREAM_PARTITION, r, 1, jnp.arange(N, dtype=jnp.uint32))
-            & jnp.uint32(1))
-    same_side = side[:, None] == side[None, :]
-    off_diag = i != j
-    return (~dropped) & (same_side | ~part_active) & off_diag
+from ..ops.adversary import delivery as _delivery  # SPEC §2 delivery mask
 
 
 def _last_term(log_term, log_len):
@@ -268,31 +252,36 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
                      commit, timer, timeout, match_idx, next_idx)
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _raft_run_jit(cfg: Config, seeds):
-    st0 = jax.vmap(lambda s: raft_init(cfg, s))(seeds)
-    rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
-
-    def scan_body(sts, r):
-        return jax.vmap(lambda s: raft_round(cfg, s, r))(sts), None
-
-    stF, _ = jax.lax.scan(scan_body, st0, rounds)
-    return stF
+def _raft_extract(st: RaftState) -> dict:
+    return {"commit": st.commit, "log_term": st.log_term, "log_val": st.log_val,
+            "term": st.term, "role": st.role}
 
 
-def raft_run(cfg: Config):
+def _raft_pspec(cfg: Config) -> RaftState:
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import NODE_AXIS as ND
+    v, m = P(ND), P(ND, None)
+    return RaftState(seed=P(), term=v, role=v, voted_for=v, log_term=m,
+                     log_val=m, log_len=v, commit=v, timer=v, timeout=v,
+                     match_idx=m, next_idx=m)
+
+
+_ENGINE = None
+
+
+def get_engine():
+    global _ENGINE
+    if _ENGINE is None:
+        from ..network.runner import EngineDef
+        _ENGINE = EngineDef("raft", raft_init, raft_round, _raft_extract,
+                            _raft_pspec)
+    return _ENGINE
+
+
+def raft_run(cfg: Config, **kw):
     """Run the full batched simulation. Returns host numpy arrays
-    {commit, log_term, log_val, term, role} with leading sweep axis [B, ...]."""
-    B = cfg.n_sweeps
-    seeds = ((np.uint64(cfg.seed) + np.arange(B, dtype=np.uint64))
-             & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-
-    stF = _raft_run_jit(cfg, seeds)
-    out = {
-        "commit": np.asarray(stF.commit),
-        "log_term": np.asarray(stF.log_term),
-        "log_val": np.asarray(stF.log_val),
-        "term": np.asarray(stF.term),
-        "role": np.asarray(stF.role),
-    }
-    return out
+    {commit, log_term, log_val, term, role} with leading sweep axis [B, ...].
+    Keyword args (mesh=, checkpoint_path=, resume=) pass through to
+    :func:`consensus_tpu.network.runner.run`."""
+    from ..network import runner
+    return runner.run(cfg, get_engine(), **kw)

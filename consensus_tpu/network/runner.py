@@ -1,0 +1,181 @@
+"""Generic batched round-loop runner shared by all four protocol engines.
+
+This is the TPU analog of the reference's `network::Simulator` round loop
+(SURVEY.md §3a): the `for round / for node` nest becomes `lax.scan` over
+rounds of a `vmap`'d round kernel, compiled once per (config, shapes).
+On top of the plain loop it provides, uniformly for every protocol:
+
+  * **mesh sharding** — carry pytrees pinned to a ("sweep", "node")
+    `Mesh` via sharding constraints (see consensus_tpu.parallel.mesh);
+  * **blocked scan** — `cfg.scan_chunk` splits the round loop into
+    fixed-size jitted chunks driven from the host, bounding XLA program
+    size and compile time for 1k+ round runs (SURVEY.md §7 hard parts);
+  * **checkpoint / resume** — between chunks the carry (a pytree of
+    arrays) can be snapshotted to an .npz; a resumed run continues the
+    scan at the saved round and produces bit-identical decided logs
+    because every round kernel is a pure function of (state, round).
+
+Engines register an :class:`EngineDef`; no protocol code lives here.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import pathlib
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.config import Config
+from ..parallel import mesh as meshlib
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineDef:
+    """A protocol engine, as seen by the runner.
+
+    make_carry(cfg, seed) -> carry    # unbatched; vmapped over sweeps
+    round_fn(cfg, carry, r) -> carry  # one round; pure; r = absolute round
+    extract(batched_carry) -> dict[str, np.ndarray]
+    carry_pspec(cfg) -> pytree of PartitionSpec matching the unbatched carry
+    """
+    name: str
+    make_carry: Callable[..., Any]
+    round_fn: Callable[..., Any]
+    extract: Callable[[Any], dict]
+    carry_pspec: Callable[[Config], Any]
+
+
+def make_seeds(cfg: Config) -> np.ndarray:
+    """Per-sweep u32 seeds; sweep b uses lo32(seed + b) (docs/SPEC.md §1)."""
+    return ((np.uint64(cfg.seed) + np.arange(cfg.n_sweeps, dtype=np.uint64))
+            & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",))
+def _init_jit(cfg: Config, eng: EngineDef, seeds, *, mesh=None):
+    carry = jax.vmap(lambda s: eng.make_carry(cfg, s))(seeds)
+    return meshlib.constrain(carry, cfg, mesh, eng.carry_pspec(cfg))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("mesh",))
+def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0, *, mesh=None):
+    """Advance the batched carry by ``n_rounds`` rounds starting at ``r0``.
+
+    The round body must stay inside a scan of length >= 2: XLA unrolls a
+    length-1 scan into the top-level computation, and the CPU backend's
+    codegen of the unrolled round kernel is pathological (minutes for a
+    body that compiles in ~2s inside a while loop — measured 2026-07-29).
+    A 1-round chunk therefore scans a masked pair: round r0, then a
+    dead lane whose output is discarded leaf-wise.
+    """
+    pspec = eng.carry_pspec(cfg)
+
+    def body(c, ra):
+        r, active = ra
+        new = jax.vmap(lambda s: eng.round_fn(cfg, s, r))(c)
+        new = jax.tree.map(lambda a, b: jnp.where(active, a, b), new, c)
+        return meshlib.constrain(new, cfg, mesh, pspec), None
+
+    if n_rounds == 1:
+        rounds = jnp.stack([r0, r0])
+        active = jnp.asarray([True, False])
+    else:
+        rounds = r0 + jnp.arange(n_rounds, dtype=jnp.int32)
+        active = jnp.ones(n_rounds, bool)
+    carry, _ = jax.lax.scan(body, carry, (rounds, active))
+    return carry
+
+
+# --- checkpointing -----------------------------------------------------------
+
+def save_checkpoint(path, cfg: Config, carry, next_round: int) -> None:
+    """Snapshot the batched carry after ``next_round`` rounds have run."""
+    leaves, _ = jax.tree.flatten(carry)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(".tmp.npz")
+    np.savez(tmp, __meta__=np.frombuffer(json.dumps(
+        {"config": json.loads(cfg.to_json()), "next_round": next_round}
+    ).encode(), dtype=np.uint8), **arrays)
+    tmp.replace(path)
+
+
+def load_checkpoint(path, cfg: Config, eng: EngineDef):
+    """Return (carry, next_round) or None if absent / config mismatch."""
+    path = pathlib.Path(path)
+    if not path.exists():
+        return None
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        saved_cfg = {k: v for k, v in meta["config"].items() if k != "_cutoffs"}
+        current = json.loads(cfg.to_json())
+        current.pop("_cutoffs", None)
+        if saved_cfg != current:
+            return None
+        leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
+    template = jax.eval_shape(lambda s: _init_template(cfg, eng, s),
+                              jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32))
+    treedef = jax.tree.structure(template)
+    return jax.tree.unflatten(treedef, leaves), meta["next_round"]
+
+
+def _init_template(cfg, eng, seeds):
+    return jax.vmap(lambda s: eng.make_carry(cfg, s))(seeds)
+
+
+# --- the run loop ------------------------------------------------------------
+
+def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
+        resume: bool = False) -> dict:
+    """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
+
+    With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
+    chunk size, the host drives fixed-shape chunks (one compile for the
+    common size + one for the ragged tail) and optionally checkpoints
+    between them.
+    """
+    if mesh is None and cfg.mesh_shape:
+        mesh = meshlib.make_mesh(cfg.mesh_shape)
+    meshlib.check_divisible(cfg, mesh)
+
+    seeds = jnp.asarray(make_seeds(cfg))
+    if mesh is not None:
+        seeds = jax.device_put(seeds, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(meshlib.SWEEP_AXIS)))
+
+    start = 0
+    carry = None
+    if resume and checkpoint_path:
+        loaded = load_checkpoint(checkpoint_path, cfg, eng)
+        if loaded is not None:
+            carry, start = loaded
+            carry = jax.device_put(carry)
+    if carry is None:
+        carry = _init_jit(cfg, eng, seeds, mesh=mesh)
+
+    # A checkpoint request implies chunking — a single-chunk run would
+    # finish (or die) without ever writing a snapshot, so derive a chunk
+    # that guarantees at least one mid-run save whenever one is possible
+    # (n_rounds >= 2). 64 rounds/chunk is the SURVEY.md §7 compile-time
+    # sweet spot for long runs; results are bit-identical regardless of
+    # chunking (tests/test_runner.py).
+    if cfg.scan_chunk:
+        chunk = cfg.scan_chunk
+    elif checkpoint_path:
+        chunk = min(64, max(1, cfg.n_rounds // 2))
+    else:
+        chunk = cfg.n_rounds
+    r = start
+    while r < cfg.n_rounds:
+        n = min(chunk, cfg.n_rounds - r)
+        carry = _chunk_jit(cfg, eng, n, carry, jnp.int32(r), mesh=mesh)
+        r += n
+        if checkpoint_path and r < cfg.n_rounds:
+            save_checkpoint(checkpoint_path, cfg, carry, r)
+
+    return {k: np.asarray(v) for k, v in eng.extract(carry).items()}

@@ -44,17 +44,19 @@ def _decided_raft(out) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     return out["commit"], out["log_term"], out["log_val"]
 
 
-def run(cfg: Config, warmup: bool = True) -> RunResult:
+def run(cfg: Config, warmup: bool = True, **engine_kw) -> RunResult:
     """Run a config. With ``warmup`` (default) the TPU engine is executed
     once before the timed run so ``wall_s`` measures steady-state execution,
     not jit tracing + XLA compilation; the oracle's shared library is built
     outside the window for the same reason. Pass ``warmup=False`` for a
-    single cold run when only the decided logs matter."""
+    single cold run when only the decided logs matter. Extra keyword args
+    (mesh=, checkpoint_path=, resume=) pass through to the TPU engine's
+    :func:`consensus_tpu.network.runner.run`."""
     if cfg.engine == "tpu":
-        if warmup:
-            _run_jax(cfg)  # compile (cached by (cfg, shapes)); discard result
+        if warmup and not engine_kw.get("checkpoint_path"):
+            _run_jax(cfg, **engine_kw)  # compile; discard result
         t0 = time.perf_counter()
-        out = _run_jax(cfg)
+        out = _run_jax(cfg, **engine_kw)
         wall = time.perf_counter() - t0
     else:
         from ..oracle import bindings
@@ -89,19 +91,19 @@ def run(cfg: Config, warmup: bool = True) -> RunResult:
         counts=counts, rec_a=np.asarray(rec_a), rec_b=np.asarray(rec_b))
 
 
-def _run_jax(cfg: Config):
+def _run_jax(cfg: Config, **engine_kw):
     if cfg.protocol == "raft":
         from ..engines.raft import raft_run
-        return raft_run(cfg)
+        return raft_run(cfg, **engine_kw)
     if cfg.protocol == "paxos":
         from ..engines.paxos import paxos_run
-        return paxos_run(cfg)
+        return paxos_run(cfg, **engine_kw)
     if cfg.protocol == "pbft":
         from ..engines.pbft import pbft_run
-        return pbft_run(cfg)
+        return pbft_run(cfg, **engine_kw)
     if cfg.protocol == "dpos":
         from ..engines.dpos import dpos_run
-        return dpos_run(cfg)
+        return dpos_run(cfg, **engine_kw)
     raise NotImplementedError(cfg.protocol)
 
 

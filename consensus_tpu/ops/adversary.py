@@ -1,0 +1,57 @@
+"""Shared device-side adversary + RNG draw kernels (docs/SPEC.md §§1-2).
+
+The reference's `network::Simulator` decides message delivery, partitions
+and leader churn online with a seeded RNG [B:5]; here those decisions are
+pure counter-based threefry functions of (seed, round, edge), evaluated
+on device as vectorized draws — no RNG state threads through the scan, so
+any (round, sweep, edge) decision can be recomputed anywhere (including
+scalar-by-scalar in the C++ oracle) without shared iteration order.
+
+Used by every protocol engine; the DPoS engine uses a single-row variant
+(only the scheduled producer sends, so materializing [V, V] for 100k
+validators would be absurd — see engines/dpos.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+
+
+def draw(seed, stream, ctx, c0, c1):
+    """Device-side threefry draw — see core.rng.random_u32_jnp."""
+    return rng.random_u32_jnp(seed, stream, ctx, c0, c1)
+
+
+def cutoff(cut: int):
+    """u32 probability cutoff as a jnp constant (draw < cutoff ⇔ fire)."""
+    return jnp.uint32(cut)
+
+
+def bitcast_i32(x):
+    """Reinterpret u32 draws as i32 payload values (byte-stable across
+    engines; the oracle stores the same 32 bits)."""
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def delivery(seed, N: int, r, drop_cut: int, part_cut: int):
+    """SPEC §2: [i, j] True iff a message i→j is delivered in round r.
+
+    Composition of per-edge drops, an optional per-round bipartition
+    (nodes on different sides can't talk), and no self-delivery.
+    """
+    i = jnp.arange(N, dtype=jnp.uint32)[:, None]
+    j = jnp.arange(N, dtype=jnp.uint32)[None, :]
+    dropped = draw(seed, rng.STREAM_DELIVER, r, i, j) < cutoff(drop_cut)
+    part_active = draw(seed, rng.STREAM_PARTITION, r, 0, 0) < cutoff(part_cut)
+    side = (draw(seed, rng.STREAM_PARTITION, r, 1, jnp.arange(N, dtype=jnp.uint32))
+            & jnp.uint32(1))
+    same_side = side[:, None] == side[None, :]
+    off_diag = i != j
+    return (~dropped) & (same_side | ~part_active) & off_diag
+
+
+def churn(seed, r, churn_cut: int):
+    """SPEC §2: True iff the per-round leader-churn event fires."""
+    return draw(seed, rng.STREAM_CHURN, r, 0, 0) < cutoff(churn_cut)

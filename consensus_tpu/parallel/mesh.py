@@ -1,0 +1,82 @@
+"""Device-mesh sharding for the TPU engine (SURVEY.md §7 step 8).
+
+The reference scales by running many independent simulator processes on
+CPU cores; the TPU-native equivalent (BASELINE.json:5) is one XLA program
+partitioned over a `jax.sharding.Mesh` with two logical axes:
+
+  * ``"sweep"`` — independent simulator instances (the batch axis).
+    Embarrassingly parallel: no collectives cross it.
+  * ``"node"``  — the node population inside one simulator. Sharding this
+    axis makes GSPMD partition the per-round quorum reductions
+    (vote tallies, prepare/commit counts, promise counts) into local
+    partial sums + an ``all-reduce`` over ICI — exactly the "quorum
+    tallies psum'd across a device mesh" design in the north star.
+
+We deliberately express sharding as `NamedSharding` constraints and let
+GSPMD insert the collectives, rather than hand-writing `shard_map` +
+`psum`: the round kernels mix [i, j] edge matrices, per-node vectors and
+per-(node, slot) grids, and the compiler's partitioner handles the mixed
+contractions (and overlaps the all-reduces with compute) better than a
+hand-scheduled version. See docs/SPEC.md §8.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SWEEP_AXIS = "sweep"
+NODE_AXIS = "node"
+
+
+def make_mesh(mesh_shape, devices=None) -> Mesh:
+    """Build a ("sweep", "node") mesh.
+
+    ``mesh_shape`` is ``(n_sweep,)`` or ``(n_sweep, n_node)``; the product
+    must not exceed the available device count. ``(8,)`` shards sweeps over
+    8 chips; ``(2, 4)`` runs 2-way sweep-parallel × 4-way node-parallel.
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = tuple(int(s) for s in mesh_shape)
+    if len(shape) == 1:
+        shape = (shape[0], 1)
+    if len(shape) != 2:
+        raise ValueError(f"mesh_shape must have 1 or 2 axes, got {mesh_shape}")
+    n = math.prod(shape)
+    if n > len(devices):
+        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devices)}")
+    dev = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(dev, (SWEEP_AXIS, NODE_AXIS))
+
+
+def batched_spec(spec: P) -> P:
+    """Prepend the sweep axis to an unbatched per-leaf PartitionSpec."""
+    return P(SWEEP_AXIS, *spec)
+
+
+def constrain(carry, cfg, mesh: Mesh | None, pspec_tree):
+    """Pin the batched carry pytree to its mesh sharding (no-op without a
+    mesh). ``pspec_tree`` matches the *unbatched* carry structure; the
+    sweep axis is prepended here."""
+    if mesh is None:
+        return carry
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, batched_spec(s))),
+        carry, pspec_tree)
+
+
+def check_divisible(cfg, mesh: Mesh | None) -> None:
+    """Shard sizes must divide the batched axes (no padding semantics —
+    padding rows would change RNG-driven decided logs)."""
+    if mesh is None:
+        return
+    ns = mesh.shape[SWEEP_AXIS]
+    nn = mesh.shape[NODE_AXIS]
+    if cfg.n_sweeps % ns:
+        raise ValueError(f"n_sweeps={cfg.n_sweeps} not divisible by sweep axis {ns}")
+    if cfg.n_nodes % nn:
+        raise ValueError(f"n_nodes={cfg.n_nodes} not divisible by node axis {nn}")

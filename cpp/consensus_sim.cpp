@@ -1,0 +1,283 @@
+// consensus-sim — the native CLI driver (SURVEY.md §2 component 13).
+//
+// Plays the role of the reference's CLI binary: flags → Config → run →
+// JSON report. The CPU engine is the in-process C++ oracle (oracle.cpp);
+// `--engine tpu` re-execs `python3 -m consensus_tpu` with the same flags
+// so one front door drives both engines, mirroring the reference's
+// engine-pluggable `Consensus` trait seam (BASELINE.json:5).
+//
+// The JSON report contains the SHA-256 digest of the canonical decided-log
+// serialization (docs/SPEC.md §4) — byte-identical to the Python side's
+// `RunResult.digest`, so cross-engine equivalence is a string compare:
+//
+//   ./consensus-sim --protocol raft --nodes 5 --rounds 64 | jq .digest
+//   ./consensus-sim --engine tpu  --protocol raft ...     | jq .digest
+
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <vector>
+
+#include <unistd.h>
+
+#include "sha256.h"
+
+extern "C" {
+int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                  uint32_t log_capacity, uint32_t max_entries, uint32_t t_min,
+                  uint32_t t_max, uint32_t drop_cut, uint32_t part_cut,
+                  uint32_t churn_cut, uint32_t* out_commit,
+                  uint32_t* out_log_term, uint32_t* out_log_val,
+                  uint32_t* out_term, uint32_t* out_role);
+int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                  uint32_t n_slots, uint32_t f, uint32_t view_timeout,
+                  uint32_t n_byzantine, uint32_t drop_cut, uint32_t part_cut,
+                  uint32_t churn_cut, uint8_t* out_committed,
+                  uint32_t* out_dval, uint32_t* out_view);
+int ctpu_paxos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                   uint32_t n_slots, uint32_t n_proposers, uint32_t drop_cut,
+                   uint32_t part_cut, uint32_t churn_cut,
+                   uint32_t* out_learned_val, uint8_t* out_learned_mask,
+                   uint32_t* out_promised, uint32_t* out_acc_bal,
+                   uint32_t* out_acc_val);
+int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                  uint32_t log_capacity, uint32_t n_candidates,
+                  uint32_t n_producers, uint32_t epoch_len, uint32_t drop_cut,
+                  uint32_t part_cut, uint32_t churn_cut, uint32_t* out_chain_r,
+                  uint32_t* out_chain_p, uint32_t* out_chain_len);
+}
+
+namespace {
+
+struct Args {
+  std::string protocol = "raft";
+  std::string engine = "cpu";
+  uint32_t nodes = 5, rounds = 64, sweeps = 1;
+  uint64_t seed = 0;
+  uint32_t log_capacity = 128, max_entries = 100;
+  uint32_t t_min = 3, t_max = 8;
+  double drop_rate = 0.0, partition_rate = 0.0, churn_rate = 0.0;
+  uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
+  uint32_t n_proposers = 0;
+  uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;
+  std::string out_path;  // optional: dump raw payload bytes
+  bool nodes_given = false;
+};
+
+// Must equal consensus_tpu.core.rng.prob_threshold_u32 — both engines
+// compare raw u32 draws against the same integer cutoffs.
+uint32_t prob_threshold_u32(double p) {
+  if (p <= 0.0) return 0;
+  if (p >= 1.0) return 0xFFFFFFFFu;
+  double v = p * 4294967296.0;
+  uint64_t c = uint64_t(v);
+  return c > 0xFFFFFFFFull ? 0xFFFFFFFFu : uint32_t(c);
+}
+
+[[noreturn]] void usage(const char* argv0, int code) {
+  std::fprintf(
+      stderr,
+      "usage: %s [--protocol raft|pbft|paxos|dpos] [--engine cpu|tpu]\n"
+      "  [--nodes N] [--rounds R] [--sweeps B] [--seed S]\n"
+      "  [--log-capacity L] [--max-entries E] [--t-min T] [--t-max T]\n"
+      "  [--drop-rate P] [--partition-rate P] [--churn-rate P]\n"
+      "  [--f F] [--view-timeout T] [--n-byzantine K] [--n-proposers P]\n"
+      "  [--candidates C] [--producers K] [--epoch-len E] [--out FILE]\n",
+      argv0);
+  std::exit(code);
+}
+
+Args parse(int argc, char** argv) {
+  Args a;
+  for (int i = 1; i < argc; ++i) {
+    std::string k = argv[i];
+    auto need = [&](const char* name) -> const char* {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "missing value for %s\n", name);
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (k == "--protocol") a.protocol = need(k.c_str());
+    else if (k == "--engine") a.engine = need(k.c_str());
+    else if (k == "--nodes") { a.nodes = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10)); a.nodes_given = true; }
+    else if (k == "--rounds") a.rounds = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--sweeps") a.sweeps = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--seed") a.seed = std::strtoull(need(k.c_str()), nullptr, 10);
+    else if (k == "--log-capacity") a.log_capacity = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--max-entries") a.max_entries = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--t-min") a.t_min = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--t-max") a.t_max = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--drop-rate") a.drop_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--partition-rate") a.partition_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--churn-rate") a.churn_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--f") a.f = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--view-timeout") a.view_timeout = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--n-byzantine") a.n_byzantine = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--n-proposers") a.n_proposers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--candidates") a.n_candidates = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--producers") a.n_producers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--epoch-len") a.epoch_len = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--out") a.out_path = need(k.c_str());
+    else if (k == "--help" || k == "-h") usage(argv[0], 0);
+    else { std::fprintf(stderr, "unknown flag %s\n", k.c_str()); usage(argv[0], 2); }
+  }
+  if (a.protocol == "pbft" && !a.nodes_given) a.nodes = 3 * a.f + 1;
+  return a;
+}
+
+// Canonical serialization (docs/SPEC.md §4; mirrors core/serialize.py).
+struct Payload {
+  std::vector<uint8_t> bytes;
+
+  void u8(uint8_t v) { bytes.push_back(v); }
+  void u32(uint32_t v) {
+    for (int i = 0; i < 4; ++i) bytes.push_back(uint8_t(v >> (8 * i)));
+  }
+  void header(uint8_t proto_id, uint32_t B, uint32_t N) {
+    bytes.insert(bytes.end(), {'C', 'T', 'P', 'U'});
+    u8(1);  // version
+    u8(proto_id);
+    u32(B);
+    u32(N);
+  }
+  void records(uint32_t count, const uint32_t* a, const uint32_t* b) {
+    u32(count);
+    for (uint32_t k = 0; k < count; ++k) {
+      u32(a[k]);
+      u32(b[k]);
+    }
+  }
+  void sparse_records(uint32_t S, const uint8_t* mask, const uint32_t* val) {
+    uint32_t count = 0;
+    for (uint32_t s = 0; s < S; ++s) count += mask[s] ? 1 : 0;
+    u32(count);
+    for (uint32_t s = 0; s < S; ++s)
+      if (mask[s]) {
+        u32(s);
+        u32(val[s]);
+      }
+  }
+};
+
+double now_s() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + 1e-9 * double(ts.tv_nsec);
+}
+
+int run_cpu(const Args& a) {
+  const uint32_t N = a.nodes, R = a.rounds, B = a.sweeps;
+  const uint32_t L = a.log_capacity;
+  const uint32_t drop = prob_threshold_u32(a.drop_rate);
+  const uint32_t part = prob_threshold_u32(a.partition_rate);
+  const uint32_t churn = prob_threshold_u32(a.churn_rate);
+
+  Payload pl;
+  uint8_t proto_id = a.protocol == "raft"    ? 0
+                     : a.protocol == "pbft"  ? 1
+                     : a.protocol == "paxos" ? 2
+                     : a.protocol == "dpos"  ? 3
+                                             : 255;
+  if (proto_id == 255) {
+    std::fprintf(stderr, "unknown protocol %s\n", a.protocol.c_str());
+    return 2;
+  }
+  pl.header(proto_id, B, N);
+
+  double t0 = now_s();
+  for (uint32_t b = 0; b < B; ++b) {
+    uint64_t seed = a.seed + b;
+    if (a.protocol == "raft") {
+      std::vector<uint32_t> commit(N), term(N), role(N);
+      std::vector<uint32_t> log_term(size_t(N) * L), log_val(size_t(N) * L);
+      if (ctpu_raft_run(seed, N, R, L, a.max_entries, a.t_min, a.t_max, drop,
+                        part, churn, commit.data(), log_term.data(),
+                        log_val.data(), term.data(), role.data()))
+        return 1;
+      for (uint32_t n = 0; n < N; ++n)
+        pl.records(commit[n], &log_term[size_t(n) * L], &log_val[size_t(n) * L]);
+    } else if (a.protocol == "pbft") {
+      std::vector<uint8_t> committed(size_t(N) * L);
+      std::vector<uint32_t> dval(size_t(N) * L), view(N);
+      if (ctpu_pbft_run(seed, N, R, L, a.f, a.view_timeout, a.n_byzantine,
+                        drop, part, churn, committed.data(), dval.data(),
+                        view.data()))
+        return 1;
+      for (uint32_t n = 0; n < N; ++n)
+        pl.sparse_records(L, &committed[size_t(n) * L], &dval[size_t(n) * L]);
+    } else if (a.protocol == "paxos") {
+      std::vector<uint32_t> lval(size_t(N) * L), promised(size_t(N) * L),
+          acc_bal(size_t(N) * L), acc_val(size_t(N) * L);
+      std::vector<uint8_t> lmask(size_t(N) * L);
+      if (ctpu_paxos_run(seed, N, R, L, a.n_proposers, drop, part, churn,
+                         lval.data(), lmask.data(), promised.data(),
+                         acc_bal.data(), acc_val.data()))
+        return 1;
+      for (uint32_t n = 0; n < N; ++n)
+        pl.sparse_records(L, &lmask[size_t(n) * L], &lval[size_t(n) * L]);
+    } else {  // dpos
+      std::vector<uint32_t> chain_r(size_t(N) * L), chain_p(size_t(N) * L),
+          chain_len(N);
+      if (ctpu_dpos_run(seed, N, R, L, a.n_candidates, a.n_producers,
+                        a.epoch_len, drop, part, churn, chain_r.data(),
+                        chain_p.data(), chain_len.data()))
+        return 1;
+      for (uint32_t n = 0; n < N; ++n)
+        pl.records(chain_len[n], &chain_r[size_t(n) * L], &chain_p[size_t(n) * L]);
+    }
+  }
+  double wall = now_s() - t0;
+
+  if (!a.out_path.empty()) {
+    FILE* fp = std::fopen(a.out_path.c_str(), "wb");
+    if (!fp) { std::perror("fopen --out"); return 1; }
+    std::fwrite(pl.bytes.data(), 1, pl.bytes.size(), fp);
+    std::fclose(fp);
+  }
+
+  std::string digest = ctpu::sha256_hex(pl.bytes.data(), pl.bytes.size());
+  uint64_t steps = uint64_t(B) * N * R;
+  std::printf(
+      "{\"protocol\": \"%s\", \"engine\": \"cpu\", \"n_nodes\": %u, "
+      "\"n_rounds\": %u, \"n_sweeps\": %u, \"seed\": %" PRIu64 ", "
+      "\"steps\": %" PRIu64 ", \"wall_s\": %.6f, \"steps_per_sec\": %.1f, "
+      "\"payload_bytes\": %zu, \"digest\": \"%s\"}\n",
+      a.protocol.c_str(), N, R, B, a.seed, steps, wall,
+      wall > 0 ? double(steps) / wall : 0.0, pl.bytes.size(), digest.c_str());
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  // One front door, two engines: if the user asked for the TPU engine,
+  // hand the identical flag vector to the Python/JAX engine (the
+  // pyo3-bridge analog, BASELINE.json:5) BEFORE strict flag parsing —
+  // TPU-only flags (--mesh, --checkpoint, --profile, --config,
+  // --scan-chunk) are the Python side's to validate, not ours.
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (std::strcmp(argv[i], "--engine") == 0 &&
+        std::strcmp(argv[i + 1], "tpu") == 0) {
+      std::vector<char*> args;
+      args.push_back(const_cast<char*>("python3"));
+      args.push_back(const_cast<char*>("-m"));
+      args.push_back(const_cast<char*>("consensus_tpu"));
+      for (int j = 1; j < argc; ++j) args.push_back(argv[j]);
+      args.push_back(nullptr);
+      execvp("python3", args.data());
+      std::perror("execvp python3");
+      return 127;
+    }
+  }
+  Args a = parse(argc, argv);
+  if (a.engine != "cpu") {
+    std::fprintf(stderr, "unknown engine %s\n", a.engine.c_str());
+    return 2;
+  }
+  return run_cpu(a);
+}

@@ -1,0 +1,101 @@
+// Sanitizer selftest — runs every oracle protocol on small adversarial
+// configs. Built with -fsanitize=address,undefined (`make san-test`), it
+// is the framework's memory/UB check for the native engine (SURVEY.md §5
+// "race detection / sanitizers": the Rust reference gets memory safety
+// from the compiler; the C++ oracle earns it here). Exit 0 = clean.
+//
+// Also doubles as a determinism probe: each config runs twice and the
+// outputs must match byte-for-byte (the oracle is a pure function of
+// (config, seed); divergence would indicate uninitialized reads).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+int ctpu_raft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t*, uint32_t*,
+                  uint32_t*, uint32_t*, uint32_t*);
+int ctpu_pbft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint8_t*, uint32_t*,
+                  uint32_t*);
+int ctpu_paxos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                   uint32_t, uint32_t, uint32_t*, uint8_t*, uint32_t*,
+                   uint32_t*, uint32_t*);
+int ctpu_dpos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t*, uint32_t*,
+                  uint32_t*);
+}
+
+namespace {
+
+// ~10% drop, ~5% partition, ~5% churn as u32 cutoffs (cf. prob_threshold).
+constexpr uint32_t DROP = 429496729u;
+constexpr uint32_t PART = 214748364u;
+constexpr uint32_t CHURN = 214748364u;
+
+int fail(const char* what) {
+  std::fprintf(stderr, "selftest FAILED: %s\n", what);
+  return 1;
+}
+
+template <typename F>
+int run_twice(const char* name, size_t out_words, F&& f) {
+  std::vector<uint32_t> a(out_words, 0xDEADBEEFu), b(out_words, 0x12345678u);
+  if (f(a.data()) != 0) return fail(name);
+  if (f(b.data()) != 0) return fail(name);
+  if (std::memcmp(a.data(), b.data(), out_words * 4) != 0) {
+    std::fprintf(stderr, "selftest: %s nondeterministic\n", name);
+    return 1;
+  }
+  std::printf("selftest: %-6s ok (%zu output words)\n", name, out_words);
+  return 0;
+}
+
+}  // namespace
+
+int main() {
+  int rc = 0;
+  {
+    const uint32_t N = 7, R = 96, L = 64, E = 40;
+    size_t W = N + 2 * size_t(N) * L + N + N;
+    rc |= run_twice("raft", W, [&](uint32_t* o) {
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, o, o + N,
+                           o + N + size_t(N) * L, o + N + 2 * size_t(N) * L,
+                           o + 2 * N + 2 * size_t(N) * L);
+    });
+  }
+  {
+    const uint32_t f = 2, N = 3 * f + 1, R = 48, S = 16;
+    size_t ns = size_t(N) * S;
+    // committed (u8, round up to words) + dval + view
+    size_t W = (ns + 3) / 4 + ns + N;
+    rc |= run_twice("pbft", W, [&](uint32_t* o) {
+      return ctpu_pbft_run(77, N, R, S, f, 8, 1, DROP, PART, CHURN,
+                           reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
+                           o + (ns + 3) / 4 + ns);
+    });
+  }
+  {
+    const uint32_t N = 9, R = 32, S = 16;
+    size_t ns = size_t(N) * S;
+    size_t W = ns + (ns + 3) / 4 + 3 * ns;
+    rc |= run_twice("paxos", W, [&](uint32_t* o) {
+      return ctpu_paxos_run(55, N, R, S, 0, DROP, PART, CHURN, o,
+                            reinterpret_cast<uint8_t*>(o + ns), o + ns + (ns + 3) / 4,
+                            o + ns + (ns + 3) / 4 + ns, o + ns + (ns + 3) / 4 + 2 * ns);
+    });
+  }
+  {
+    const uint32_t V = 64, R = 64, L = 64, C = 16, K = 4, EP = 16;
+    size_t vl = size_t(V) * L;
+    size_t W = 2 * vl + V;
+    rc |= run_twice("dpos", W, [&](uint32_t* o) {
+      return ctpu_dpos_run(33, V, R, L, C, K, EP, DROP, PART, CHURN, o, o + vl,
+                           o + 2 * vl);
+    });
+  }
+  if (rc == 0) std::printf("selftest: ALL CLEAN\n");
+  return rc;
+}

@@ -1,12 +1,23 @@
 """Test harness: run JAX on a virtual 8-device CPU mesh (SURVEY.md §4.4).
 
-Must set env BEFORE jax initializes a backend. Tests exercise the same
-shard_map code path that runs on a real v5e-8; bench.py (not under pytest)
-uses the real TPU chip.
+Tests exercise the same mesh-sharded code path that runs on a real v5e-8;
+bench.py (not under pytest) uses the real TPU chip.
+
+The container's axon sitecustomize force-registers the TPU plugin and
+overwrites ``JAX_PLATFORMS`` before pytest ever runs, so an env
+``setdefault`` is not enough — we must both set the env (for the XLA CPU
+client flags) and override the already-imported jax config.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()} — sharding tests "
+    "would silently run unsharded")

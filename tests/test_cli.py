@@ -1,0 +1,106 @@
+"""CLI front-door tests (SURVEY.md §2 component 13).
+
+The native `cpp/consensus-sim` binary and `python -m consensus_tpu` must
+report the *same digest* for the same flags — that is the reference's
+engine-pluggable seam made observable: one CLI, two engines, byte-equal
+decided logs (BASELINE.json:2,5).
+"""
+import hashlib
+import json
+import pathlib
+import subprocess
+
+import pytest
+
+from consensus_tpu import cli
+
+CPP_DIR = pathlib.Path(__file__).resolve().parents[1] / "cpp"
+SIM = CPP_DIR / "consensus-sim"
+
+FLAG_SETS = {
+    "raft": ["--protocol", "raft", "--nodes", "5", "--rounds", "64",
+             "--sweeps", "2", "--log-capacity", "32", "--max-entries", "20",
+             "--drop-rate", "0.1", "--churn-rate", "0.05"],
+    "pbft": ["--protocol", "pbft", "--f", "1", "--rounds", "24",
+             "--log-capacity", "8", "--drop-rate", "0.1"],
+    "paxos": ["--protocol", "paxos", "--nodes", "7", "--rounds", "24",
+              "--log-capacity", "8", "--drop-rate", "0.1"],
+    "dpos": ["--protocol", "dpos", "--nodes", "24", "--rounds", "32",
+             "--log-capacity", "48", "--candidates", "8", "--producers", "3",
+             "--epoch-len", "8", "--drop-rate", "0.1"],
+}
+
+
+def _build_sim():
+    subprocess.run(["make", "-C", str(CPP_DIR), "-s", "consensus-sim"],
+                   check=True)
+
+
+def _run_native(flags, extra=()):
+    _build_sim()
+    out = subprocess.run([str(SIM), *flags, *extra], check=True,
+                         capture_output=True, text=True)
+    return json.loads(out.stdout)
+
+
+@pytest.mark.parametrize("proto", list(FLAG_SETS))
+def test_native_cli_digest_matches_tpu_engine(proto, capsys):
+    native = _run_native(FLAG_SETS[proto])
+    # TPU engine in-process (pytest runs on the virtual CPU mesh backend,
+    # same jit code path as the chip).
+    rc = cli.main(FLAG_SETS[proto] + ["--engine", "tpu"])
+    assert rc == 0
+    ours = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert native["digest"] == ours["digest"], (native, ours)
+    assert native["payload_bytes"] == ours["payload_bytes"]
+
+
+def test_native_sha256_matches_hashlib(tmp_path):
+    payload = tmp_path / "p.bin"
+    native = _run_native(FLAG_SETS["raft"], extra=["--out", str(payload)])
+    data = payload.read_bytes()
+    assert len(data) == native["payload_bytes"]
+    assert hashlib.sha256(data).hexdigest() == native["digest"]
+
+
+def test_python_cli_cpu_engine_matches_native(capsys):
+    native = _run_native(FLAG_SETS["paxos"])
+    rc = cli.main(FLAG_SETS["paxos"] + ["--engine", "cpu"])
+    assert rc == 0
+    ours = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert native["digest"] == ours["digest"]
+
+
+def test_cli_mesh_flag(capsys):
+    rc = cli.main(FLAG_SETS["raft"] + ["--engine", "tpu", "--mesh", "2x1"])
+    assert rc == 0
+    sharded = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    native = _run_native(FLAG_SETS["raft"])
+    assert sharded["digest"] == native["digest"]
+
+
+def test_cli_config_file_values_survive(tmp_path, capsys):
+    # A --config file must fully drive the run; only flags the user
+    # actually types may override it (review finding: argparse defaults
+    # were stomping every file value).
+    cfgfile = tmp_path / "cfg.json"
+    args = cli.build_parser().parse_args(FLAG_SETS["raft"] + ["--engine", "cpu"])
+    cfg = cli.args_to_config(args)
+    cfgfile.write_text(cfg.to_json())
+    rc = cli.main(["--config", str(cfgfile)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    native = _run_native(FLAG_SETS["raft"])
+    assert out["digest"] == native["digest"]
+    assert out["engine"] == "cpu" and out["n_rounds"] == 64
+
+
+def test_cli_typed_flag_overrides_config_file(tmp_path, capsys):
+    cfgfile = tmp_path / "cfg.json"
+    args = cli.build_parser().parse_args(FLAG_SETS["raft"] + ["--engine", "cpu"])
+    cfgfile.write_text(cli.args_to_config(args).to_json())
+    rc = cli.main(["--config", str(cfgfile), "--seed", "9"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["seed"] == 9
+    assert out["n_rounds"] == 64  # untyped flag: file value survives

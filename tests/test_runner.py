@@ -1,0 +1,124 @@
+"""Runner features: mesh sharding, blocked scan, checkpoint/resume
+(SURVEY.md §4.4 — same code path as a real v5e-8, on the virtual CPU mesh),
+plus the driver entry points in __graft_entry__.py.
+
+Everything must be *bit-identical* to the plain single-device run: the
+decided log is the observable, and sharding/chunking/resume are execution
+strategies, not semantic changes.
+"""
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.engines import dpos, paxos, pbft, raft
+from consensus_tpu.network import runner
+from consensus_tpu.parallel.mesh import make_mesh
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+ADV = dict(drop_rate=0.1, partition_rate=0.05, churn_rate=0.05)
+
+CFGS = {
+    "raft": Config(protocol="raft", n_nodes=8, n_rounds=48, n_sweeps=4,
+                   log_capacity=16, max_entries=8, **ADV),
+    "pbft": Config(protocol="pbft", f=1, n_nodes=4, n_rounds=24, n_sweeps=4,
+                   log_capacity=8, **ADV),
+    "paxos": Config(protocol="paxos", n_nodes=8, n_rounds=24, n_sweeps=4,
+                    log_capacity=8, **ADV),
+    "dpos": Config(protocol="dpos", n_nodes=16, n_rounds=32, n_sweeps=4,
+                   log_capacity=64, n_candidates=8, n_producers=2,
+                   epoch_len=8, **ADV),
+}
+RUNS = {"raft": raft.raft_run, "pbft": pbft.pbft_run,
+        "paxos": paxos.paxos_run, "dpos": dpos.dpos_run}
+
+
+def _assert_same(a: dict, b: dict) -> None:
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.mark.parametrize("proto", list(CFGS))
+def test_sharded_equals_unsharded(proto):
+    cfg = CFGS[proto]
+    base = RUNS[proto](cfg)
+    mesh = make_mesh((2, 4) if cfg.n_nodes % 4 == 0 else (2, 2))
+    _assert_same(base, RUNS[proto](cfg, mesh=mesh))
+
+
+@pytest.mark.parametrize("proto", ["raft", "paxos"])
+def test_sweep_only_mesh_via_config(proto):
+    cfg = CFGS[proto]
+    base = RUNS[proto](cfg)
+    import dataclasses
+    cfg8 = dataclasses.replace(cfg, mesh_shape=(4,))
+    _assert_same(base, RUNS[proto](cfg8))
+
+
+@pytest.mark.parametrize("proto", list(CFGS))
+def test_chunked_scan_equals_plain(proto):
+    import dataclasses
+    cfg = CFGS[proto]
+    base = RUNS[proto](cfg)
+    # chunk size that doesn't divide n_rounds → exercises the ragged tail
+    cfgc = dataclasses.replace(cfg, scan_chunk=7)
+    _assert_same(base, RUNS[proto](cfgc))
+
+
+def test_checkpoint_resume_bit_identical(tmp_path):
+    import dataclasses
+    cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
+    base = RUNS["raft"](cfg)
+
+    # Interrupt after one chunk: run 16 rounds by hand, save, resume.
+    eng = raft.get_engine()
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    carry = runner._init_jit(cfg, eng, seeds)
+    carry = runner._chunk_jit(cfg, eng, 16, carry, jnp.int32(0))
+    ckpt = tmp_path / "raft.ckpt.npz"
+    runner.save_checkpoint(ckpt, cfg, carry, 16)
+
+    resumed = raft.raft_run(cfg, checkpoint_path=ckpt, resume=True)
+    _assert_same(base, resumed)
+
+
+def test_checkpoint_config_mismatch_is_ignored(tmp_path):
+    import dataclasses
+    cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
+    eng = raft.get_engine()
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    carry = runner._init_jit(cfg, eng, seeds)
+    ckpt = tmp_path / "raft.ckpt.npz"
+    runner.save_checkpoint(ckpt, cfg, carry, 16)
+
+    other = dataclasses.replace(cfg, seed=cfg.seed + 1)
+    assert runner.load_checkpoint(ckpt, other, eng) is None
+    # A resume request against a mismatched checkpoint falls back to a
+    # fresh run — identical to never having checkpointed.
+    _assert_same(RUNS["raft"](other),
+                 raft.raft_run(other, checkpoint_path=ckpt, resume=True))
+
+
+def test_mesh_divisibility_rejected():
+    import dataclasses
+    cfg = dataclasses.replace(CFGS["raft"], n_sweeps=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        raft.raft_run(cfg, mesh=make_mesh((2, 1)))
+
+
+def test_graft_entry_compiles():
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.term.shape == args[0].term.shape
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)

@@ -1,0 +1,18 @@
+"""Native-engine sanitizer gate (SURVEY.md §5 "race detection").
+
+Builds the oracle + selftest with -fsanitize=address,undefined and runs
+every protocol on adversarial configs twice (determinism check inside).
+The Rust reference gets memory safety from its compiler; the C++ oracle
+earns it here on every test run.
+"""
+import pathlib
+import subprocess
+
+CPP = pathlib.Path(__file__).resolve().parents[1] / "cpp"
+
+
+def test_oracle_asan_ubsan_clean():
+    out = subprocess.run(["make", "-C", str(CPP), "-s", "san-test"],
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ALL CLEAN" in out.stdout
