@@ -122,32 +122,58 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true",
                     help="small pbft ladder, fewer repeats")
     ap.add_argument("--skip-oracle", action="store_true")
+    ap.add_argument("--skip-tpu", action="store_true",
+                    help="oracle baseline only (no JAX engine runs) — used "
+                         "to produce the BASELINE.md single-core numbers "
+                         "when no accelerator is reachable")
     ap.add_argument("--only", default="",
                     help="comma-separated subset of config names")
+    ap.add_argument("--out", default="",
+                    help="output JSON path (default benchmarks/RESULTS.json)")
     args = ap.parse_args()
 
-    import jax
-    dev = jax.devices()[0]
-    print(f"benchmarks: device={dev} platform={dev.platform}", file=sys.stderr)
-
-    results = {"device": str(dev), "platform": dev.platform,
-               "timestamp": time.time(), "rows": []}
+    if args.skip_tpu:
+        results = {"device": "none (oracle only)", "platform": "cpu-oracle",
+                   "timestamp": time.time(), "rows": []}
+    else:
+        import jax
+        dev = jax.devices()[0]
+        print(f"benchmarks: device={dev} platform={dev.platform}",
+              file=sys.stderr)
+        results = {"device": str(dev), "platform": dev.platform,
+                   "timestamp": time.time(), "rows": []}
     only = set(args.only.split(",")) if args.only else None
 
     for name, cfg in CONFIGS.items():
         if only and name not in only:
             continue
-        row = {"name": name, "tpu": time_tpu(cfg)}
+        row = {"name": name}
+        if not args.skip_tpu:
+            row["tpu"] = time_tpu(cfg)
         if not args.skip_oracle:
             row["oracle"] = time_oracle(ORACLE_SIZED.get(name, cfg))
         results["rows"].append(row)
         _progress(row)
 
     if not only or any(n.startswith("pbft") for n in only):
-        fs = PBFT_FS[:4] if args.quick else PBFT_FS
-        results["rows"] += bench_pbft_sweep(fs, args.quick, args.skip_oracle)
+        if args.skip_tpu:
+            for f in (PBFT_FS[:4] if args.quick else PBFT_FS):
+                if f > 32 and args.quick:
+                    continue
+                cfg = Config(protocol="pbft", f=f, n_nodes=3 * f + 1,
+                             n_rounds=32, n_sweeps=1, log_capacity=32,
+                             seed=3, **ADV)
+                row = {"name": f"pbft-f{f}",
+                       "oracle": time_oracle(cfg, repeats=1)}
+                results["rows"].append(row)
+                _progress(row)
+        else:
+            fs = PBFT_FS[:4] if args.quick else PBFT_FS
+            results["rows"] += bench_pbft_sweep(fs, args.quick,
+                                                args.skip_oracle)
 
-    out_path = pathlib.Path(__file__).parent / "RESULTS.json"
+    out_path = pathlib.Path(args.out) if args.out else \
+        pathlib.Path(__file__).parent / "RESULTS.json"
     out_path.write_text(json.dumps(results, indent=2))
     print(f"wrote {out_path}", file=sys.stderr)
 
