@@ -1,0 +1,55 @@
+// The native engine seam — the C++ analog of the reference's pluggable
+// `Consensus` trait (SURVEY.md §2 component 1, BASELINE.json:5: a new
+// backend slots in behind one interface and "the CLI and
+// network::Simulator driver are unchanged").
+//
+// `consensus-sim` (the native CLI) is written against this interface
+// only: it configures an Engine by name, runs it, and serializes the
+// decided log through the uniform record accessors — it has no
+// per-protocol knowledge. The Python side's equivalent seam is
+// consensus_tpu.network.runner.EngineDef.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+namespace ctpu {
+
+// One config schema shared by every engine (mirrors
+// consensus_tpu.core.config.Config; unused fields ignored per protocol).
+struct SimConfig {
+  uint64_t seed = 0;
+  uint32_t n_nodes = 5;
+  uint32_t n_rounds = 64;
+  uint32_t log_capacity = 128;  // raft log length / pbft+paxos slots / dpos chain
+  uint32_t max_entries = 100;
+  uint32_t t_min = 3, t_max = 8;
+  uint32_t drop_cut = 0, part_cut = 0, churn_cut = 0;  // u32 cutoffs
+  uint32_t f = 1, view_timeout = 8, n_byzantine = 0;   // pbft
+  uint32_t n_proposers = 0;                            // paxos
+  uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;  // dpos
+};
+
+// A consensus engine: run the whole simulation, then expose each node's
+// decided log as (a, b) u32 record pairs in canonical order
+// (docs/SPEC.md §4 / core/serialize.py).
+class Engine {
+ public:
+  virtual ~Engine() = default;
+  virtual const char* name() const = 0;
+  // Returns 0 on success, nonzero on invalid config.
+  virtual int run(const SimConfig& cfg) = 0;
+  virtual uint32_t n_nodes() const = 0;
+  virtual uint32_t decided_count(uint32_t node) const = 0;
+  // Fill a[0..count) and b[0..count) for `node` (count = decided_count).
+  virtual void decided_records(uint32_t node, uint32_t* a, uint32_t* b) const = 0;
+};
+
+// Factory over the protocol registry. Returns nullptr for unknown names.
+std::unique_ptr<Engine> make_engine(const std::string& protocol);
+
+// Canonical protocol ids for the serialized header (serialize.py).
+int protocol_id(const std::string& protocol);
+
+}  // namespace ctpu

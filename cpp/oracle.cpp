@@ -13,8 +13,11 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <memory>
+#include <string>
 #include <vector>
 
+#include "engine.h"
 #include "threefry.h"
 
 namespace ctpu {
@@ -607,6 +610,155 @@ struct DposSim {
 };
 
 }  // namespace
+
+// ---------------------------------------------------------------------------
+// Engine trait impls (engine.h) — the native `Consensus`-trait seam.
+// Each adapter owns a Sim, maps SimConfig onto it, and exposes the
+// decided log as canonical (a, b) records; the CLI never sees a Sim.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+class RaftEngine final : public Engine {
+ public:
+  const char* name() const override { return "raft"; }
+  int run(const SimConfig& c) override {
+    if (c.n_nodes == 0 || c.t_max <= c.t_min) return 1;
+    sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
+    sim_.L = c.log_capacity; sim_.E = c.max_entries;
+    sim_.t_min = c.t_min; sim_.t_max = c.t_max;
+    sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
+    sim_.churn_cut = c.churn_cut;
+    sim_.run();
+    return 0;
+  }
+  uint32_t n_nodes() const override { return sim_.N; }
+  uint32_t decided_count(uint32_t n) const override { return sim_.commit[n]; }
+  void decided_records(uint32_t n, uint32_t* a, uint32_t* b) const override {
+    for (uint32_t k = 0; k < sim_.commit[n]; ++k) {
+      a[k] = sim_.log_term[size_t(n) * sim_.L + k];
+      b[k] = sim_.log_val[size_t(n) * sim_.L + k];
+    }
+  }
+
+ private:
+  RaftSim sim_;
+};
+
+// Shared shape for the two [node, slot] sparse-decided protocols.
+template <typename Sim>
+class SlotEngine : public Engine {
+ public:
+  uint32_t n_nodes() const override { return sim_.N; }
+  uint32_t decided_count(uint32_t n) const override {
+    uint32_t c = 0;
+    for (uint32_t s = 0; s < slots(); ++s) c += mask()[size_t(n) * slots() + s] ? 1 : 0;
+    return c;
+  }
+  void decided_records(uint32_t n, uint32_t* a, uint32_t* b) const override {
+    uint32_t k = 0;
+    for (uint32_t s = 0; s < slots(); ++s)
+      if (mask()[size_t(n) * slots() + s]) {
+        a[k] = s;
+        b[k] = vals()[size_t(n) * slots() + s];
+        ++k;
+      }
+  }
+
+ protected:
+  virtual uint32_t slots() const = 0;
+  virtual const uint8_t* mask() const = 0;
+  virtual const uint32_t* vals() const = 0;
+  Sim sim_;
+};
+
+class PbftEngine final : public SlotEngine<PbftSim> {
+ public:
+  const char* name() const override { return "pbft"; }
+  int run(const SimConfig& c) override {
+    if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f) return 1;
+    sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
+    sim_.S = c.log_capacity; sim_.f = c.f;
+    sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
+    sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
+    sim_.churn_cut = c.churn_cut;
+    sim_.run();
+    return 0;
+  }
+
+ protected:
+  uint32_t slots() const override { return sim_.S; }
+  const uint8_t* mask() const override { return sim_.committed.data(); }
+  const uint32_t* vals() const override { return sim_.dval.data(); }
+};
+
+class PaxosEngine final : public SlotEngine<PaxosSim> {
+ public:
+  const char* name() const override { return "paxos"; }
+  int run(const SimConfig& c) override {
+    if (c.n_nodes == 0 || c.log_capacity == 0) return 1;
+    sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
+    sim_.S = c.log_capacity;
+    sim_.P = c.n_proposers ? c.n_proposers : c.n_nodes;
+    sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
+    sim_.churn_cut = c.churn_cut;
+    sim_.run();
+    return 0;
+  }
+
+ protected:
+  uint32_t slots() const override { return sim_.S; }
+  const uint8_t* mask() const override { return sim_.learned_mask.data(); }
+  const uint32_t* vals() const override { return sim_.learned_val.data(); }
+};
+
+class DposEngine final : public Engine {
+ public:
+  const char* name() const override { return "dpos"; }
+  int run(const SimConfig& c) override {
+    if (c.n_nodes == 0 || c.n_candidates == 0 || c.n_producers == 0 ||
+        c.n_producers > c.n_candidates || c.n_candidates > c.n_nodes ||
+        c.epoch_len == 0)
+      return 1;
+    sim_.seed = c.seed; sim_.V = c.n_nodes; sim_.R = c.n_rounds;
+    sim_.L = c.log_capacity; sim_.C = c.n_candidates; sim_.K = c.n_producers;
+    sim_.epoch_len = c.epoch_len;
+    sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
+    sim_.churn_cut = c.churn_cut;
+    sim_.run();
+    return 0;
+  }
+  uint32_t n_nodes() const override { return sim_.V; }
+  uint32_t decided_count(uint32_t v) const override { return sim_.chain_len[v]; }
+  void decided_records(uint32_t v, uint32_t* a, uint32_t* b) const override {
+    for (uint32_t k = 0; k < sim_.chain_len[v]; ++k) {
+      a[k] = sim_.chain_r[size_t(v) * sim_.L + k];
+      b[k] = sim_.chain_p[size_t(v) * sim_.L + k];
+    }
+  }
+
+ private:
+  DposSim sim_;
+};
+
+}  // namespace
+
+std::unique_ptr<Engine> make_engine(const std::string& protocol) {
+  if (protocol == "raft") return std::make_unique<RaftEngine>();
+  if (protocol == "pbft") return std::make_unique<PbftEngine>();
+  if (protocol == "paxos") return std::make_unique<PaxosEngine>();
+  if (protocol == "dpos") return std::make_unique<DposEngine>();
+  return nullptr;
+}
+
+int protocol_id(const std::string& protocol) {
+  if (protocol == "raft") return 0;
+  if (protocol == "pbft") return 1;
+  if (protocol == "paxos") return 2;
+  if (protocol == "dpos") return 3;
+  return -1;
+}
+
 }  // namespace ctpu
 
 // ---------------------------------------------------------------------------
