@@ -1,13 +1,21 @@
-"""Driver benchmark: one JSON line on stdout.
+"""Driver benchmark: one JSON line on stdout, always (rc 0 even on failure).
 
-Flagship config: the Raft 1k-node × 1k-round batched log-match sweep
+Flagship config: the Raft 1k-node x 1k-round batched log-match sweep
 (BASELINE.md config 2) on the real TPU chip. Metric is
 node-round-steps/sec (BASELINE.json:2); ``vs_baseline`` is the ratio
 against the driver's north-star target of 10M steps/sec/chip
 (BASELINE.json:5 — the reference publishes no numbers of its own,
 BASELINE.json:13, so the target is the only defined baseline).
 
-Usage: python bench.py [--nodes N] [--rounds R] [--sweeps B] [--json-only]
+Robustness (VERDICT.md round 1, weak #1): the TPU backend (axon tunnel)
+can hang or be UNAVAILABLE. Backend init is therefore probed in a
+*subprocess* with a hard timeout and retried with backoff; on persistent
+failure the benchmark falls back to the XLA CPU backend on a smaller
+round count, labels the metric accordingly, and still emits valid JSON —
+the driver's one perf capture per round is never lost to a stack trace.
+
+Usage: python bench.py [--nodes N] [--rounds R] [--sweeps B]
+                       [--probe-timeout S] [--probe-retries K]
 """
 from __future__ import annotations
 
@@ -16,8 +24,18 @@ import json
 import sys
 import time
 
+from consensus_tpu.utils.platform import ensure_platform, watchdog
+
 
 NORTH_STAR_STEPS_PER_SEC = 10_000_000.0
+
+
+def log(msg: str) -> None:
+    print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+def emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
 
 
 def main() -> None:
@@ -29,16 +47,58 @@ def main() -> None:
     ap.add_argument("--drop-rate", type=float, default=0.01)
     ap.add_argument("--churn-rate", type=float, default=0.001)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--probe-retries", type=int, default=3)
+    ap.add_argument("--run-timeout", type=float, default=1800.0,
+                    help="hard deadline for the whole benchmark; on expiry "
+                         "an error JSON is emitted and the process exits 0 "
+                         "(guards against a tunnel that drops mid-run and "
+                         "hangs in native code, where no except: can fire)")
+    ap.add_argument("--cpu-fallback-rounds", type=int, default=64,
+                    help="round count when falling back to the CPU backend "
+                         "(steps/sec is a rate; fewer rounds keep wall time "
+                         "bounded without changing the metric's meaning)")
     args = ap.parse_args()
     args.repeats = max(1, args.repeats)
 
+    plat_tag = ensure_platform("auto", probe_timeout=args.probe_timeout,
+                               retries=args.probe_retries)
+    if plat_tag.startswith("cpu"):
+        # Still produce a number, on a smaller round count; the metric
+        # name says so explicitly (honest labeling).
+        args.rounds = min(args.rounds, args.cpu_fallback_rounds)
+        log(f"CPU fallback; rounds -> {args.rounds}")
+    else:
+        log(f"accelerator ok, platform={plat_tag}")
+
+    metric = (f"raft-{args.nodes}node-{args.rounds}round "
+              f"node-round-steps/sec [{plat_tag}]")
+
+    def on_timeout():
+        log(f"FAILED: exceeded --run-timeout {args.run_timeout:.0f}s "
+            "(backend hang mid-run?)")
+        emit({"metric": metric, "value": 0.0, "unit": "steps/sec",
+              "vs_baseline": 0.0,
+              "error": f"hang: benchmark exceeded {args.run_timeout:.0f}s"})
+
+    try:
+        with watchdog(args.run_timeout, on_timeout):
+            run_benchmark(args, metric)
+    except Exception as exc:  # noqa: BLE001 — the failure mode must be data
+        log(f"FAILED: {type(exc).__name__}: {exc}")
+        emit({"metric": metric, "value": 0.0, "unit": "steps/sec",
+              "vs_baseline": 0.0,
+              "error": f"{type(exc).__name__}: {exc}"[:500]})
+
+
+def run_benchmark(args, metric: str) -> None:
     import jax
 
     from consensus_tpu.core.config import Config
     from consensus_tpu.engines.raft import raft_run
 
     dev = jax.devices()[0]
-    print(f"bench: device={dev}, platform={dev.platform}", file=sys.stderr)
+    log(f"device={dev}, platform={dev.platform}")
 
     cfg = Config(
         protocol="raft", engine="tpu",
@@ -51,34 +111,31 @@ def main() -> None:
 
     t0 = time.perf_counter()
     raft_run(cfg)  # compile + warm up
-    print(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s",
-          file=sys.stderr)
+    log(f"warmup (incl. compile) {time.perf_counter() - t0:.1f}s")
 
     best = float("inf")
+    out = None
     for i in range(args.repeats):
         t0 = time.perf_counter()
         out = raft_run(cfg)
         dt = time.perf_counter() - t0
         best = min(best, dt)
-        print(f"bench: run {i}: {dt:.3f}s = {steps / dt / 1e6:.2f}M steps/s",
-              file=sys.stderr)
+        log(f"run {i}: {dt:.3f}s = {steps / dt / 1e6:.2f}M steps/s")
 
     # Sanity: the simulation must actually decide entries, or the number
-    # is meaningless — fail loudly rather than report idle throughput.
+    # is meaningless — report it as an error *in the JSON*, not a crash.
     committed = int(out["commit"].max())
-    print(f"bench: max committed entries = {committed}", file=sys.stderr)
-    if committed == 0:
-        print("bench: FAILED — nothing committed; config is degenerate",
-              file=sys.stderr)
-        sys.exit(1)
-
+    log(f"max committed entries = {committed}")
     value = steps / best
-    print(json.dumps({
-        "metric": "raft-1k-node-1k-round node-round-steps/sec",
+    result = {
+        "metric": metric,
         "value": round(value, 1),
         "unit": "steps/sec",
         "vs_baseline": round(value / NORTH_STAR_STEPS_PER_SEC, 4),
-    }))
+    }
+    if committed == 0:
+        result["error"] = "degenerate run: nothing committed"
+    emit(result)
 
 
 if __name__ == "__main__":

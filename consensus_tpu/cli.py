@@ -66,6 +66,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "(TPU engine only)")
     ap.add_argument("--config", default="",
                     help="JSON config file; typed flags override its values")
+    ap.add_argument("--platform", default="auto",
+                    choices=["auto", "cpu", "tpu", "tpu-trust"],
+                    help="JAX backend for the tpu engine: auto probes the "
+                         "accelerator in a subprocess (hang-proof, costs "
+                         "one extra backend init ~seconds) and falls back "
+                         "to the XLA CPU backend; cpu pins CPU; tpu "
+                         "requires the accelerator or fails fast; "
+                         "tpu-trust skips the probe entirely (fastest, "
+                         "but hangs if the tunnel is down)")
+    ap.add_argument("--probe-timeout", type=float, default=90.0,
+                    help="accelerator probe timeout in seconds")
     return ap
 
 
@@ -96,8 +107,35 @@ def args_to_config(args):
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     cfg = args_to_config(args)
+
+    if cfg.engine != "tpu":
+        # TPU-engine-only features must not be silently ignored. Name the
+        # actual source: a typed flag, or a field inherited via --config.
+        typed = vars(args)
+        rejected = [name for name, on in [
+            ("--mesh" if "mesh" in typed else "config field mesh_shape",
+             "mesh" in typed or cfg.mesh_shape),
+            ("--checkpoint", args.checkpoint),
+            ("--profile", args.profile),
+            ("--scan-chunk" if "scan_chunk" in typed
+             else "config field scan_chunk",
+             cfg.scan_chunk),
+        ] if on]
+        if rejected:
+            parser.error(f"{', '.join(rejected)}: only valid with "
+                         f"--engine tpu (got --engine {cfg.engine})")
+
+    platform_tag = "oracle"
+    if cfg.engine == "tpu":
+        if args.platform == "tpu-trust":
+            platform_tag = "tpu-trust"  # no probe; init may hang if down
+        else:
+            from .utils.platform import ensure_platform
+            platform_tag = ensure_platform(
+                args.platform, probe_timeout=args.probe_timeout)
 
     from .network import simulator
 
@@ -117,8 +155,9 @@ def main(argv=None) -> int:
         with open(args.out, "wb") as f:
             f.write(result.payload)
 
-    print(json.dumps({
+    report = {
         "protocol": cfg.protocol, "engine": cfg.engine,
+        "platform": platform_tag,
         "n_nodes": cfg.n_nodes, "n_rounds": cfg.n_rounds,
         "n_sweeps": cfg.n_sweeps, "seed": cfg.seed,
         "steps": result.node_round_steps,
@@ -126,7 +165,12 @@ def main(argv=None) -> int:
         "steps_per_sec": round(result.steps_per_sec, 1),
         "payload_bytes": len(result.payload),
         "digest": result.digest,
-    }))
+    }
+    if result.timing_includes_compile:
+        # steps/sec includes jit+compile (checkpoint runs skip warmup) —
+        # flag it so the number isn't read as steady-state throughput.
+        report["timing_includes_compile"] = True
+    print(json.dumps(report))
     return 0
 
 

@@ -131,13 +131,18 @@ def _init_template(cfg, eng, seeds):
 # --- the run loop ------------------------------------------------------------
 
 def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
-        resume: bool = False) -> dict:
+        resume: bool = False, stats: dict | None = None) -> dict:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
     chunk size, the host drives fixed-shape chunks (one compile for the
     common size + one for the ragged tail) and optionally checkpoints
     between them.
+
+    If ``stats`` is given it is filled with ``start_round`` and
+    ``executed_rounds`` so callers can report throughput for the rounds
+    this call actually ran (a resumed run skips the first
+    ``start_round`` rounds — counting them would inflate steps/sec).
     """
     if mesh is None and cfg.mesh_shape:
         mesh = meshlib.make_mesh(cfg.mesh_shape)
@@ -177,5 +182,9 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         r += n
         if checkpoint_path and r < cfg.n_rounds:
             save_checkpoint(checkpoint_path, cfg, carry, r)
+
+    if stats is not None:
+        stats["start_round"] = start
+        stats["executed_rounds"] = cfg.n_rounds - start
 
     return {k: np.asarray(v) for k, v in eng.extract(carry).items()}

@@ -1,0 +1,127 @@
+"""Hang-proof JAX backend selection.
+
+The TPU chip is reached through a remote-tunnel PJRT plugin whose backend
+init can block indefinitely when the tunnel is down — and in-process init
+cannot be timed out (it blocks in C++). Every entry point that might run
+on the accelerator therefore selects its platform through
+:func:`ensure_platform`, which probes backend init in a *subprocess* with
+a hard timeout and falls back to the XLA CPU backend instead of hanging
+(VERDICT.md round 1, weak #1: a down tunnel must cost a label, not the run).
+
+The container's sitecustomize force-sets ``JAX_PLATFORMS`` at interpreter
+startup, so pinning CPU requires both the env var (for XLA CPU client
+flags) and a ``jax.config`` override on the already-imported module —
+the same dance as tests/conftest.py.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import subprocess
+import sys
+import threading
+import time
+
+CPU_FALLBACK_TAG = "cpu-fallback:accelerator-unavailable"
+
+
+@contextlib.contextmanager
+def watchdog(timeout_s: float, on_timeout):
+    """Hard deadline for a block that may hang in native code.
+
+    A daemon thread calls ``on_timeout()`` and then ``os._exit(0)`` if the
+    block does not finish in time. Signal- or exception-based timeouts
+    cannot interrupt a PJRT call stuck in C++; process exit can. Use only
+    around terminal work (e.g. an entire benchmark) where the emergency
+    path is "emit the failure as data and stop".
+    """
+    def fire():
+        try:
+            on_timeout()
+        finally:
+            os._exit(0)
+
+    t = threading.Timer(timeout_s, fire)
+    t.daemon = True
+    t.start()
+    try:
+        yield
+    finally:
+        t.cancel()
+
+
+def log(msg: str) -> None:
+    print(f"platform: {msg}", file=sys.stderr, flush=True)
+
+
+def pin_cpu() -> None:
+    """Force this process onto the XLA CPU backend."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def probe_accelerator(timeout_s: float = 90.0) -> str | None:
+    """Initialize the default backend in a subprocess with a hard timeout
+    and run one op; return its platform name if it is a real accelerator.
+    """
+    code = ("import jax; d = jax.devices(); "
+            "x = (jax.numpy.ones((128,128)) @ jax.numpy.ones((128,128)))"
+            ".block_until_ready(); print('PLATFORM=' + d[0].platform)")
+    try:
+        p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"probe: backend init exceeded {timeout_s:.0f}s (hung tunnel)")
+        return None
+    for line in p.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            plat = line.split("=", 1)[1]
+            if plat != "cpu":
+                return plat
+            log("probe: default backend is cpu (no accelerator registered)")
+            return None
+    tail = (p.stderr or p.stdout).strip().splitlines()
+    log(f"probe: init failed rc={p.returncode}: {tail[-1] if tail else '?'}")
+    return None
+
+
+def ensure_platform(requested: str = "auto", *, probe_timeout: float = 90.0,
+                    retries: int = 1, backoff_s: float = 15.0) -> str:
+    """Select and pin the JAX platform for this process; return its tag.
+
+    requested:
+      * ``"cpu"``  — pin the CPU backend, no probe.
+      * ``"auto"`` — if the environment already pins CPU, keep it; else
+        probe the accelerator (with retries) and fall back to CPU with
+        the tag :data:`CPU_FALLBACK_TAG` when it is unreachable.
+      * anything else (``"tpu"``/``"axon"``) — require the accelerator;
+        raise RuntimeError (instead of hanging) when the probe fails.
+    """
+    if requested == "cpu":
+        pin_cpu()
+        return "cpu"
+    if requested == "auto" and os.environ.get("JAX_PLATFORMS") == "cpu":
+        pin_cpu()  # idempotent; also covers a sitecustomize re-override
+        return "cpu"
+
+    plat = None
+    for attempt in range(max(1, retries)):
+        plat = probe_accelerator(probe_timeout)
+        if plat:
+            break
+        if attempt + 1 < retries:
+            wait = backoff_s * (attempt + 1)
+            log(f"probe: retrying in {wait:.0f}s ({attempt + 1}/{retries} failed)")
+            time.sleep(wait)
+
+    if plat:
+        return plat
+    if requested == "auto":
+        log("accelerator unreachable — falling back to the CPU backend")
+        pin_cpu()
+        return CPU_FALLBACK_TAG
+    raise RuntimeError(
+        f"accelerator platform {requested!r} requested but backend init "
+        f"failed/hung (probe timeout {probe_timeout:.0f}s, {retries} tries)")

@@ -14,42 +14,20 @@
 //   ./consensus-sim --engine tpu  --protocol raft ...     | jq .digest
 
 #include <cinttypes>
+#include <climits>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <memory>
 #include <string>
 #include <vector>
 
 #include <unistd.h>
 
+#include "engine.h"
 #include "sha256.h"
-
-extern "C" {
-int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
-                  uint32_t log_capacity, uint32_t max_entries, uint32_t t_min,
-                  uint32_t t_max, uint32_t drop_cut, uint32_t part_cut,
-                  uint32_t churn_cut, uint32_t* out_commit,
-                  uint32_t* out_log_term, uint32_t* out_log_val,
-                  uint32_t* out_term, uint32_t* out_role);
-int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
-                  uint32_t n_slots, uint32_t f, uint32_t view_timeout,
-                  uint32_t n_byzantine, uint32_t drop_cut, uint32_t part_cut,
-                  uint32_t churn_cut, uint8_t* out_committed,
-                  uint32_t* out_dval, uint32_t* out_view);
-int ctpu_paxos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
-                   uint32_t n_slots, uint32_t n_proposers, uint32_t drop_cut,
-                   uint32_t part_cut, uint32_t churn_cut,
-                   uint32_t* out_learned_val, uint8_t* out_learned_mask,
-                   uint32_t* out_promised, uint32_t* out_acc_bal,
-                   uint32_t* out_acc_val);
-int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
-                  uint32_t log_capacity, uint32_t n_candidates,
-                  uint32_t n_producers, uint32_t epoch_len, uint32_t drop_cut,
-                  uint32_t part_cut, uint32_t churn_cut, uint32_t* out_chain_r,
-                  uint32_t* out_chain_p, uint32_t* out_chain_len);
-}
 
 namespace {
 
@@ -152,16 +130,6 @@ struct Payload {
       u32(b[k]);
     }
   }
-  void sparse_records(uint32_t S, const uint8_t* mask, const uint32_t* val) {
-    uint32_t count = 0;
-    for (uint32_t s = 0; s < S; ++s) count += mask[s] ? 1 : 0;
-    u32(count);
-    for (uint32_t s = 0; s < S; ++s)
-      if (mask[s]) {
-        u32(s);
-        u32(val[s]);
-      }
-  }
 };
 
 double now_s() {
@@ -171,64 +139,52 @@ double now_s() {
 }
 
 int run_cpu(const Args& a) {
+  // Protocol-agnostic: everything below goes through the Engine seam
+  // (engine.h) — configure by name, run, read uniform decided records.
   const uint32_t N = a.nodes, R = a.rounds, B = a.sweeps;
-  const uint32_t L = a.log_capacity;
-  const uint32_t drop = prob_threshold_u32(a.drop_rate);
-  const uint32_t part = prob_threshold_u32(a.partition_rate);
-  const uint32_t churn = prob_threshold_u32(a.churn_rate);
-
-  Payload pl;
-  uint8_t proto_id = a.protocol == "raft"    ? 0
-                     : a.protocol == "pbft"  ? 1
-                     : a.protocol == "paxos" ? 2
-                     : a.protocol == "dpos"  ? 3
-                                             : 255;
-  if (proto_id == 255) {
+  const int proto_id = ctpu::protocol_id(a.protocol);
+  if (proto_id < 0) {
     std::fprintf(stderr, "unknown protocol %s\n", a.protocol.c_str());
     return 2;
   }
-  pl.header(proto_id, B, N);
+
+  ctpu::SimConfig cfg;
+  cfg.n_nodes = N;
+  cfg.n_rounds = R;
+  cfg.log_capacity = a.log_capacity;
+  cfg.max_entries = a.max_entries;
+  cfg.t_min = a.t_min;
+  cfg.t_max = a.t_max;
+  cfg.drop_cut = prob_threshold_u32(a.drop_rate);
+  cfg.part_cut = prob_threshold_u32(a.partition_rate);
+  cfg.churn_cut = prob_threshold_u32(a.churn_rate);
+  cfg.f = a.f;
+  cfg.view_timeout = a.view_timeout;
+  cfg.n_byzantine = a.n_byzantine;
+  cfg.n_proposers = a.n_proposers;
+  cfg.n_candidates = a.n_candidates;
+  cfg.n_producers = a.n_producers;
+  cfg.epoch_len = a.epoch_len;
+
+  Payload pl;
+  pl.header(uint8_t(proto_id), B, N);
+
+  // Records per node are bounded by the slot/log capacity for every
+  // protocol, so one scratch pair serves the whole run.
+  std::vector<uint32_t> rec_a(a.log_capacity), rec_b(a.log_capacity);
 
   double t0 = now_s();
   for (uint32_t b = 0; b < B; ++b) {
-    uint64_t seed = a.seed + b;
-    if (a.protocol == "raft") {
-      std::vector<uint32_t> commit(N), term(N), role(N);
-      std::vector<uint32_t> log_term(size_t(N) * L), log_val(size_t(N) * L);
-      if (ctpu_raft_run(seed, N, R, L, a.max_entries, a.t_min, a.t_max, drop,
-                        part, churn, commit.data(), log_term.data(),
-                        log_val.data(), term.data(), role.data()))
-        return 1;
-      for (uint32_t n = 0; n < N; ++n)
-        pl.records(commit[n], &log_term[size_t(n) * L], &log_val[size_t(n) * L]);
-    } else if (a.protocol == "pbft") {
-      std::vector<uint8_t> committed(size_t(N) * L);
-      std::vector<uint32_t> dval(size_t(N) * L), view(N);
-      if (ctpu_pbft_run(seed, N, R, L, a.f, a.view_timeout, a.n_byzantine,
-                        drop, part, churn, committed.data(), dval.data(),
-                        view.data()))
-        return 1;
-      for (uint32_t n = 0; n < N; ++n)
-        pl.sparse_records(L, &committed[size_t(n) * L], &dval[size_t(n) * L]);
-    } else if (a.protocol == "paxos") {
-      std::vector<uint32_t> lval(size_t(N) * L), promised(size_t(N) * L),
-          acc_bal(size_t(N) * L), acc_val(size_t(N) * L);
-      std::vector<uint8_t> lmask(size_t(N) * L);
-      if (ctpu_paxos_run(seed, N, R, L, a.n_proposers, drop, part, churn,
-                         lval.data(), lmask.data(), promised.data(),
-                         acc_bal.data(), acc_val.data()))
-        return 1;
-      for (uint32_t n = 0; n < N; ++n)
-        pl.sparse_records(L, &lmask[size_t(n) * L], &lval[size_t(n) * L]);
-    } else {  // dpos
-      std::vector<uint32_t> chain_r(size_t(N) * L), chain_p(size_t(N) * L),
-          chain_len(N);
-      if (ctpu_dpos_run(seed, N, R, L, a.n_candidates, a.n_producers,
-                        a.epoch_len, drop, part, churn, chain_r.data(),
-                        chain_p.data(), chain_len.data()))
-        return 1;
-      for (uint32_t n = 0; n < N; ++n)
-        pl.records(chain_len[n], &chain_r[size_t(n) * L], &chain_p[size_t(n) * L]);
+    std::unique_ptr<ctpu::Engine> eng = ctpu::make_engine(a.protocol);
+    cfg.seed = a.seed + b;
+    if (eng->run(cfg)) {
+      std::fprintf(stderr, "%s: invalid config\n", eng->name());
+      return 1;
+    }
+    for (uint32_t n = 0; n < N; ++n) {
+      const uint32_t count = eng->decided_count(n);
+      eng->decided_records(n, rec_a.data(), rec_b.data());
+      pl.records(count, rec_a.data(), rec_b.data());
     }
   }
   double wall = now_s() - t0;
@@ -243,13 +199,35 @@ int run_cpu(const Args& a) {
   std::string digest = ctpu::sha256_hex(pl.bytes.data(), pl.bytes.size());
   uint64_t steps = uint64_t(B) * N * R;
   std::printf(
-      "{\"protocol\": \"%s\", \"engine\": \"cpu\", \"n_nodes\": %u, "
+      "{\"protocol\": \"%s\", \"engine\": \"cpu\", \"platform\": \"oracle\", "
+      "\"n_nodes\": %u, "
       "\"n_rounds\": %u, \"n_sweeps\": %u, \"seed\": %" PRIu64 ", "
       "\"steps\": %" PRIu64 ", \"wall_s\": %.6f, \"steps_per_sec\": %.1f, "
       "\"payload_bytes\": %zu, \"digest\": \"%s\"}\n",
       a.protocol.c_str(), N, R, B, a.seed, steps, wall,
       wall > 0 ? double(steps) / wall : 0.0, pl.bytes.size(), digest.c_str());
   return 0;
+}
+
+}  // namespace
+
+namespace {
+
+// The consensus_tpu package lives one directory above this binary
+// (repo/cpp/consensus-sim → repo/). Prepend that to PYTHONPATH so the
+// `--engine tpu` re-exec resolves from any working directory.
+void export_repo_root_pythonpath() {
+  char resolved[PATH_MAX];
+  if (!realpath("/proc/self/exe", resolved)) return;
+  std::string p(resolved);
+  for (int up = 0; up < 2; ++up) {
+    size_t slash = p.rfind('/');
+    if (slash == std::string::npos) return;
+    p.resize(slash);
+  }
+  const char* old = std::getenv("PYTHONPATH");
+  std::string val = (old && *old) ? p + ":" + old : p;
+  setenv("PYTHONPATH", val.c_str(), 1);
 }
 
 }  // namespace
@@ -263,6 +241,7 @@ int main(int argc, char** argv) {
   for (int i = 1; i + 1 < argc; ++i) {
     if (std::strcmp(argv[i], "--engine") == 0 &&
         std::strcmp(argv[i + 1], "tpu") == 0) {
+      export_repo_root_pythonpath();
       std::vector<char*> args;
       args.push_back(const_cast<char*>("python3"));
       args.push_back(const_cast<char*>("-m"));

@@ -36,7 +36,15 @@ class Sha256 {
     }
   }
 
-  std::string hexdigest() {
+  // Safe to call more than once and to keep updating afterwards: the
+  // padding + final block run on a copy, never on this object's state.
+  std::string hexdigest() const {
+    Sha256 t(*this);
+    return t.finalize_();
+  }
+
+ private:
+  std::string finalize_() {
     uint64_t bitlen = len_ * 8;
     uint8_t pad = 0x80;
     update(&pad, 1);
@@ -59,7 +67,6 @@ class Sha256 {
     return std::string(out);
   }
 
- private:
   static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
 
   void block(const uint8_t* p) {

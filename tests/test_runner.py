@@ -105,6 +105,44 @@ def test_checkpoint_config_mismatch_is_ignored(tmp_path):
                  raft.raft_run(other, checkpoint_path=ckpt, resume=True))
 
 
+def test_resume_reports_executed_rounds_only(tmp_path):
+    """A resumed run's stats (and the simulator's steps/sec) must count
+    only the rounds it actually executed (ADVICE r1 #2)."""
+    import dataclasses
+    cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
+    eng = raft.get_engine()
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    carry = runner._init_jit(cfg, eng, seeds)
+    carry = runner._chunk_jit(cfg, eng, 16, carry, jnp.int32(0))
+    # Separate files: a resumed run overwrites its checkpoint as it
+    # advances, which would move the second resume's start round.
+    ckpt = tmp_path / "raft.ckpt.npz"
+    ckpt2 = tmp_path / "raft2.ckpt.npz"
+    runner.save_checkpoint(ckpt, cfg, carry, 16)
+    runner.save_checkpoint(ckpt2, cfg, carry, 16)
+
+    stats = {}
+    runner.run(cfg, eng, checkpoint_path=ckpt, resume=True, stats=stats)
+    assert stats == {"start_round": 16,
+                     "executed_rounds": cfg.n_rounds - 16}
+
+    from consensus_tpu.network import simulator
+    res = simulator.run(cfg, checkpoint_path=str(ckpt2), resume=True)
+    assert res.node_round_steps == \
+        cfg.n_sweeps * cfg.n_nodes * (cfg.n_rounds - 16)
+    assert res.timing_includes_compile
+
+
+def test_engine_kw_rejected_on_cpu_engine():
+    """TPU-only run options must not be silently ignored (ADVICE r1 #3)."""
+    import dataclasses
+
+    from consensus_tpu.network import simulator
+    cfg = dataclasses.replace(CFGS["raft"], engine="cpu")
+    with pytest.raises(ValueError, match="only apply to the tpu engine"):
+        simulator.run(cfg, checkpoint_path="/tmp/nope.npz", resume=True)
+
+
 def test_mesh_divisibility_rejected():
     import dataclasses
     cfg = dataclasses.replace(CFGS["raft"], n_sweeps=3)
