@@ -77,7 +77,70 @@ def build_parser() -> argparse.ArgumentParser:
                          "but hangs if the tunnel is down)")
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="accelerator probe timeout in seconds")
+    ap.add_argument("--f-sweep", default="",
+                    help="pbft + tpu engine only: run a whole f ladder "
+                         "('1..128' or '1,2,4') as ONE compiled padded "
+                         "program (engines/pbft_sweep.py); element k uses "
+                         "f=fs[k], seed=seed+k. Reports real-node steps/sec "
+                         "+ the digest of the concatenated per-f canonical "
+                         "payloads (byte-equal to running each f alone)")
     return ap
+
+
+def _parse_fsweep(spec: str) -> list[int]:
+    """Parse '1..128' / '1,2,4' into a validated list of f values."""
+    try:
+        if ".." in spec:
+            lo, hi = spec.split("..")
+            fs = list(range(int(lo), int(hi) + 1))
+        else:
+            fs = [int(x) for x in spec.split(",")]
+    except ValueError:
+        raise ValueError(f"malformed --f-sweep spec {spec!r} "
+                         "(expected 'LO..HI' or comma-separated ints)")
+    if not fs:
+        raise ValueError(f"--f-sweep {spec!r} is an empty range")
+    if min(fs) < 1:
+        raise ValueError(f"--f-sweep values must be >= 1, got {min(fs)}")
+    return fs
+
+
+def _run_fsweep(cfg, args, platform_tag: str) -> int:
+    """Run the padded single-program PBFT f-sweep and report one JSON line."""
+    import time
+
+    from .core import serialize
+    from .engines.pbft_sweep import pbft_fsweep_run
+
+    fs = args.parsed_fs
+    t0 = time.perf_counter()
+    out = pbft_fsweep_run(cfg, fs)          # compile + warm up
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = pbft_fsweep_run(cfg, fs)
+    wall = time.perf_counter() - t0
+
+    payload = b""
+    for o in out:
+        c, s, v = serialize.pack_sparse(
+            o["committed"][None].astype(bool), o["dval"][None])
+        payload += serialize.serialize_decided("pbft", c, s, v)
+    if args.out:
+        with open(args.out, "wb") as fp:
+            fp.write(payload)
+
+    steps = sum(3 * f + 1 for f in fs) * cfg.n_rounds  # real nodes only
+    print(json.dumps({
+        "protocol": "pbft", "engine": "tpu", "platform": platform_tag,
+        "f_sweep": args.f_sweep, "n_elements": len(fs),
+        "n_rounds": cfg.n_rounds, "seed": cfg.seed,
+        "steps": steps, "wall_s": round(wall, 6),
+        "steps_per_sec": round(steps / wall, 1) if wall > 0 else 0.0,
+        "compile_s_one_program": round(compile_s, 3),
+        "payload_bytes": len(payload),
+        "digest": serialize.digest(payload),
+    }))
+    return 0
 
 
 def args_to_config(args):
@@ -128,6 +191,15 @@ def main(argv=None) -> int:
             parser.error(f"{', '.join(rejected)}: only valid with "
                          f"--engine tpu (got --engine {cfg.engine})")
 
+    # Usage errors must fail fast — before any accelerator probe.
+    if args.f_sweep:
+        if cfg.protocol != "pbft" or cfg.engine != "tpu":
+            parser.error("--f-sweep requires --protocol pbft --engine tpu")
+        try:
+            args.parsed_fs = _parse_fsweep(args.f_sweep)
+        except ValueError as exc:
+            parser.error(str(exc))
+
     platform_tag = "oracle"
     if cfg.engine == "tpu":
         if args.platform == "tpu-trust":
@@ -136,6 +208,9 @@ def main(argv=None) -> int:
             from .utils.platform import ensure_platform
             platform_tag = ensure_platform(
                 args.platform, probe_timeout=args.probe_timeout)
+
+    if args.f_sweep:
+        return _run_fsweep(cfg, args, platform_tag)
 
     from .network import simulator
 

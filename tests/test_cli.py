@@ -95,6 +95,43 @@ def test_cli_config_file_values_survive(tmp_path, capsys):
     assert out["engine"] == "cpu" and out["n_rounds"] == 64
 
 
+def test_cli_fsweep_digest_matches_per_f_runs(capsys):
+    """--f-sweep (one padded compiled program) must serialize byte-equal to
+    running each f alone: element k == a single-sweep run with f=fs[k],
+    seed=seed+k (engines/pbft_sweep.py's padding contract, VERDICT r1 #5)."""
+    fs = [1, 2, 4]
+    base = ["--protocol", "pbft", "--rounds", "24", "--log-capacity", "8",
+            "--drop-rate", "0.1", "--seed", "7"]
+    rc = cli.main(base + ["--engine", "tpu", "--f-sweep", "1,2,4"])
+    assert rc == 0
+    sweep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    expected = b""
+    import dataclasses
+
+    from consensus_tpu.core.config import Config
+    from consensus_tpu.network import simulator
+    for k, f in enumerate(fs):
+        cfg = Config(protocol="pbft", f=f, n_nodes=3 * f + 1, n_rounds=24,
+                     log_capacity=8, drop_rate=0.1, seed=7 + k)
+        expected += simulator.run(cfg, warmup=False).payload
+    import hashlib as h
+    assert sweep["digest"] == h.sha256(expected).hexdigest()
+    assert sweep["payload_bytes"] == len(expected)
+    assert sweep["steps"] == sum(3 * f + 1 for f in fs) * 24
+
+
+def test_cli_fsweep_requires_pbft_tpu():
+    with pytest.raises(SystemExit):
+        cli.main(["--protocol", "raft", "--engine", "tpu",
+                  "--f-sweep", "1..4"])
+
+
+def test_cli_rejects_tpu_flags_on_cpu_engine():
+    with pytest.raises(SystemExit):
+        cli.main(FLAG_SETS["raft"] + ["--engine", "cpu", "--mesh", "2x1"])
+
+
 def test_cli_typed_flag_overrides_config_file(tmp_path, capsys):
     cfgfile = tmp_path / "cfg.json"
     args = cli.build_parser().parse_args(FLAG_SETS["raft"] + ["--engine", "cpu"])
