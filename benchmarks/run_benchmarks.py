@@ -102,23 +102,15 @@ def bench_pbft_fsweep(fs, repeats: int = 3) -> dict:
     not simulated work, so they may not inflate steps/sec. Compile time is
     reported separately (it is the cost the padding design amortizes).
     """
-    from consensus_tpu.engines.pbft_sweep import pbft_fsweep_run
+    from consensus_tpu.engines.pbft_sweep import pbft_fsweep_timed
 
     f_max = max(fs)
     cfg = Config(protocol="pbft", f=f_max, n_nodes=3 * f_max + 1, n_rounds=32,
                  n_sweeps=1, log_capacity=32, seed=3, **ADV)
-    t0 = time.perf_counter()
-    out = pbft_fsweep_run(cfg, fs)
-    compile_s = time.perf_counter() - t0
+    out, compile_s, best, real_steps = pbft_fsweep_timed(cfg, fs,
+                                                         repeats=repeats)
     assert any(o["committed"].any() for o in out), "f-sweep committed nothing"
 
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = pbft_fsweep_run(cfg, fs)
-        best = min(best, time.perf_counter() - t0)
-
-    real_steps = sum((3 * f + 1) * cfg.n_rounds for f in fs)
     padded_steps = len(fs) * (3 * f_max + 1) * cfg.n_rounds
     return {"engine": "tpu", "fs": [int(f) for f in fs],
             "n_rounds": cfg.n_rounds, "log_capacity": cfg.log_capacity,

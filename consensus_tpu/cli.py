@@ -32,14 +32,16 @@ _FLAG_FIELDS = {
     "f": ("f", 1),
     "view_timeout": ("view_timeout", 8),
     "n_byzantine": ("n_byzantine", 0),
+    "byz_mode": ("byz_mode", "silent"),
     "n_proposers": ("n_proposers", 0),
     "candidates": ("n_candidates", 16),
     "producers": ("n_producers", 4),
     "epoch_len": ("epoch_len", 16),
     "scan_chunk": ("scan_chunk", 0),
 }
-_FLAG_TYPES = {"protocol": str, "engine": str, "drop_rate": float,
-               "partition_rate": float, "churn_rate": float}
+_FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
+               "drop_rate": float, "partition_rate": float,
+               "churn_rate": float}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -107,18 +109,11 @@ def _parse_fsweep(spec: str) -> list[int]:
 
 def _run_fsweep(cfg, args, platform_tag: str) -> int:
     """Run the padded single-program PBFT f-sweep and report one JSON line."""
-    import time
-
     from .core import serialize
-    from .engines.pbft_sweep import pbft_fsweep_run
+    from .engines.pbft_sweep import pbft_fsweep_timed
 
     fs = args.parsed_fs
-    t0 = time.perf_counter()
-    out = pbft_fsweep_run(cfg, fs)          # compile + warm up
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = pbft_fsweep_run(cfg, fs)
-    wall = time.perf_counter() - t0
+    out, compile_s, wall, steps = pbft_fsweep_timed(cfg, fs)
 
     payload = b""
     for o in out:
@@ -129,7 +124,6 @@ def _run_fsweep(cfg, args, platform_tag: str) -> int:
         with open(args.out, "wb") as fp:
             fp.write(payload)
 
-    steps = sum(3 * f + 1 for f in fs) * cfg.n_rounds  # real nodes only
     print(json.dumps({
         "protocol": "pbft", "engine": "tpu", "platform": platform_tag,
         "f_sweep": args.f_sweep, "n_elements": len(fs),
@@ -195,6 +189,15 @@ def main(argv=None) -> int:
     if args.f_sweep:
         if cfg.protocol != "pbft" or cfg.engine != "tpu":
             parser.error("--f-sweep requires --protocol pbft --engine tpu")
+        unsupported = [name for name, on in [
+            ("--checkpoint", args.checkpoint),
+            ("--profile", args.profile),
+            ("--sweeps", cfg.n_sweeps != 1),
+        ] if on]
+        if unsupported:
+            parser.error(f"{', '.join(unsupported)}: not supported with "
+                         "--f-sweep (the sweep axis is the f ladder itself; "
+                         "no checkpoint/profile hooks on this path yet)")
         try:
             args.parsed_fs = _parse_fsweep(args.f_sweep)
         except ValueError as exc:

@@ -46,7 +46,8 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     # PBFT.
     f: int = 1                   # byzantine tolerance; n_nodes = 3f+1
     view_timeout: int = 8        # rounds without progress before view change
-    n_byzantine: int = 0         # silent-faulty nodes (ids >= N - n_byzantine)
+    n_byzantine: int = 0         # byzantine nodes (ids >= N - n_byzantine)
+    byz_mode: str = "silent"     # "silent" | "equivocate" (SPEC §6)
 
     # Paxos.
     n_proposers: int = 0         # 0 ⇒ all nodes propose
@@ -74,6 +75,8 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
                     f"pbft requires n_nodes == 3f+1 == {expect}, got {self.n_nodes}")
             if self.n_byzantine > self.f:
                 raise ValueError("n_byzantine must be <= f")
+        if self.byz_mode not in ("silent", "equivocate"):
+            raise ValueError(f"unknown byz_mode {self.byz_mode!r}")
         if self.t_max <= self.t_min:
             raise ValueError("t_max must exceed t_min")
 

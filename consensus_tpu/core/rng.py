@@ -50,6 +50,7 @@ STREAM_STAKE = np.uint32(0x165667B1)    # per validator initial stake (DPoS)
 STREAM_VOTE = np.uint32(0xD3A2646C)     # per (epoch, validator) vote target
 STREAM_VALUE = np.uint32(0xFD7046C5)    # proposal payload values
 STREAM_BYZANTINE = np.uint32(0xB55A4F09)  # per-config byzantine node pick
+STREAM_EQUIV = np.uint32(0x94D049BB)    # per (round, byz sender, receiver) stance
 
 
 def _rotl32_np(x: np.ndarray, r: int) -> np.ndarray:

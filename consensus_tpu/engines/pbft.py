@@ -54,9 +54,20 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
 
     deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
-    honest = idx < (N - cfg.n_byzantine)          # byzantine-silent senders
+    honest = idx < (N - cfg.n_byzantine)
     d_h = deliver & honest[:, None]               # honest-sender delivery
     d_self_h = (deliver | jnp.eye(N, dtype=bool)) & honest[:, None]
+
+    # Equivocating byzantine senders (SPEC §6 byz_mode="equivocate"):
+    # sup[i, j] is byz i's per-receiver stance this round — it may back
+    # conflicting values at different receivers simultaneously.
+    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
+    if equiv:
+        byz = ~honest
+        sup = (_draw(seed, rng.STREAM_EQUIV, ur,
+                     idx[:, None].astype(jnp.uint32),
+                     idx[None, :].astype(jnp.uint32))
+               & jnp.uint32(1)).astype(bool)      # [i, j]
 
     view, timer = st.view, st.timer
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
@@ -98,6 +109,18 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
     prim_ok = del_self[prim, idx] & (view[prim] == view)               # [N]
     pm_b = ppb[prim]                               # [N, S] primary's broadcast
     pm_val = msg_val[prim]
+    if equiv:
+        # A byzantine primary offers every slot, per-receiver conflicting
+        # values, claiming the receiver's own view (no view-match guard).
+        prim_byz = byz[prim]                                           # [N]
+        bval = _i32(_draw(seed, rng.STREAM_VALUE,
+                          view[:, None].astype(jnp.uint32),
+                          jnp.where(sup[prim, idx], 4, 3)[:, None]
+                          .astype(jnp.uint32),
+                          sarange[None, :].astype(jnp.uint32)))        # [N, S]
+        prim_ok = jnp.where(prim_byz, del_self[prim, idx], prim_ok)
+        pm_b = pm_b | prim_byz[:, None]
+        pm_val = jnp.where(prim_byz[:, None], bval, pm_val)
     accept = (prim_ok[:, None] & pm_b
               & (~pp_seen | (pp_view < view[:, None]))
               & (~prepared | (pm_val == pp_val)))
@@ -109,11 +132,19 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
     val_eq = pp_val[:, None, :] == pp_val[None, :, :]                  # [i, j, s]
     pcount = jnp.sum(d_self_h[:, :, None] & pp_seen[:, None, :] & val_eq,
                      axis=0, dtype=jnp.int32)                          # [j, s]
+    if equiv:
+        # Byz i claims support for exactly j's value iff sup[i, j] —
+        # value-independent, so one [j] count serves every slot.
+        extra = jnp.sum(deliver & byz[:, None] & sup, axis=0,
+                        dtype=jnp.int32)                               # [j]
+        pcount = pcount + extra[:, None]
     prepared = prepared | (pp_seen & (pcount >= Q))
 
     # ---- P5 commit tally.
     ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
                      axis=0, dtype=jnp.int32)
+    if equiv:
+        ccount = ccount + extra[:, None]
     commit_now = prepared & (ccount >= Q) & ~committed
     dval = jnp.where(commit_now, pp_val, dval)
     committed = committed | commit_now

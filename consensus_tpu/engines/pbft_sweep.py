@@ -59,6 +59,16 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     d_h = deliver & honest[:, None]
     d_self_h = (deliver | jnp.eye(N, dtype=bool)) & honest[:, None]
 
+    # Equivocators (SPEC §6 byz_mode="equivocate") — same absolute-id
+    # keyed draws as the unpadded engine, so padding stays byte-invisible.
+    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
+    if equiv:
+        byz = real & ~honest
+        sup = (_draw(seed, rng.STREAM_EQUIV, ur,
+                     idx[:, None].astype(jnp.uint32),
+                     idx[None, :].astype(jnp.uint32))
+               & jnp.uint32(1)).astype(bool)
+
     view, timer = st.view, st.timer
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
     prepared, committed, dval = st.prepared, st.committed, st.dval
@@ -102,6 +112,16 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     prim_ok = del_self[prim, idx] & (view[prim] == view) & real
     pm_b = ppb[prim]
     pm_val = msg_val[prim]
+    if equiv:
+        prim_byz = byz[prim]
+        bval = _i32(_draw(seed, rng.STREAM_VALUE,
+                          view[:, None].astype(jnp.uint32),
+                          jnp.where(sup[prim, idx], 4, 3)[:, None]
+                          .astype(jnp.uint32),
+                          sarange[None, :].astype(jnp.uint32)))
+        prim_ok = jnp.where(prim_byz, del_self[prim, idx] & real, prim_ok)
+        pm_b = pm_b | prim_byz[:, None]
+        pm_val = jnp.where(prim_byz[:, None], bval, pm_val)
     accept = (prim_ok[:, None] & pm_b
               & (~pp_seen | (pp_view < view[:, None]))
               & (~prepared | (pm_val == pp_val)))
@@ -113,11 +133,17 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     val_eq = pp_val[:, None, :] == pp_val[None, :, :]
     pcount = jnp.sum(d_self_h[:, :, None] & pp_seen[:, None, :] & val_eq,
                      axis=0, dtype=jnp.int32)
+    if equiv:
+        extra = jnp.sum(deliver & byz[:, None] & sup, axis=0,
+                        dtype=jnp.int32)
+        pcount = pcount + extra[:, None]
     prepared = prepared | (pp_seen & (pcount >= Q))
 
     # ---- P5 commit tally.
     ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
                      axis=0, dtype=jnp.int32)
+    if equiv:
+        ccount = ccount + extra[:, None]
     commit_now = prepared & (ccount >= Q) & ~committed
     dval = jnp.where(commit_now, pp_val, dval)
     committed = committed | commit_now
@@ -153,6 +179,30 @@ def _fsweep_jit(cfg: Config, seeds, n_reals, fs):
 
     stF, _ = jax.lax.scan(body, st0, rounds)
     return stF
+
+
+def pbft_fsweep_timed(cfg: Config, fs, repeats: int = 1):
+    """Shared measurement harness for the one-program f-sweep (used by the
+    CLI's --f-sweep and benchmarks/run_benchmarks.py, so their timing
+    policy and step accounting cannot drift apart).
+
+    Returns ``(out, compile_s, best_wall_s, real_steps)`` where the first
+    call's wall time is the compile+warmup cost, ``best_wall_s`` is the
+    best of ``repeats`` warm executions, and ``real_steps`` counts only
+    real 3f+1 nodes — padded lanes are FLOP waste, not simulated work.
+    """
+    import time
+
+    t0 = time.perf_counter()
+    out = pbft_fsweep_run(cfg, fs)
+    compile_s = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        out = pbft_fsweep_run(cfg, fs)
+        best = min(best, time.perf_counter() - t0)
+    real_steps = sum(3 * int(f) + 1 for f in fs) * cfg.n_rounds
+    return out, compile_s, best, real_steps
 
 
 def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:

@@ -40,6 +40,7 @@ struct Args {
   uint32_t t_min = 3, t_max = 8;
   double drop_rate = 0.0, partition_rate = 0.0, churn_rate = 0.0;
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
+  std::string byz_mode = "silent";
   uint32_t n_proposers = 0;
   uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;
   std::string out_path;  // optional: dump raw payload bytes
@@ -63,7 +64,8 @@ uint32_t prob_threshold_u32(double p) {
       "  [--nodes N] [--rounds R] [--sweeps B] [--seed S]\n"
       "  [--log-capacity L] [--max-entries E] [--t-min T] [--t-max T]\n"
       "  [--drop-rate P] [--partition-rate P] [--churn-rate P]\n"
-      "  [--f F] [--view-timeout T] [--n-byzantine K] [--n-proposers P]\n"
+      "  [--f F] [--view-timeout T] [--n-byzantine K]\n"
+      "  [--byz-mode silent|equivocate] [--n-proposers P]\n"
       "  [--candidates C] [--producers K] [--epoch-len E] [--out FILE]\n",
       argv0);
   std::exit(code);
@@ -96,6 +98,7 @@ Args parse(int argc, char** argv) {
     else if (k == "--f") a.f = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--view-timeout") a.view_timeout = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--n-byzantine") a.n_byzantine = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--byz-mode") a.byz_mode = need(k.c_str());
     else if (k == "--n-proposers") a.n_proposers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--candidates") a.n_candidates = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--producers") a.n_producers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
@@ -105,6 +108,10 @@ Args parse(int argc, char** argv) {
     else { std::fprintf(stderr, "unknown flag %s\n", k.c_str()); usage(argv[0], 2); }
   }
   if (a.protocol == "pbft" && !a.nodes_given) a.nodes = 3 * a.f + 1;
+  if (a.byz_mode != "silent" && a.byz_mode != "equivocate") {
+    std::fprintf(stderr, "unknown --byz-mode %s\n", a.byz_mode.c_str());
+    std::exit(2);
+  }
   return a;
 }
 
@@ -161,6 +168,7 @@ int run_cpu(const Args& a) {
   cfg.f = a.f;
   cfg.view_timeout = a.view_timeout;
   cfg.n_byzantine = a.n_byzantine;
+  cfg.byz_equivocate = a.byz_mode == "equivocate" ? 1 : 0;
   cfg.n_proposers = a.n_proposers;
   cfg.n_candidates = a.n_candidates;
   cfg.n_producers = a.n_producers;

@@ -275,6 +275,7 @@ struct RaftSim {
 struct PbftSim {
   uint64_t seed;
   uint32_t N, R, S, f, view_timeout, n_byz;
+  uint32_t equiv = 0;  // byz_mode == "equivocate" (SPEC §6)
   uint32_t drop_cut, part_cut, churn_cut;
 
   std::vector<uint32_t> view, timer;                    // [N]
@@ -284,6 +285,10 @@ struct PbftSim {
 
   size_t at(uint32_t n, uint32_t s) const { return size_t(n) * S + s; }
   bool honest(uint32_t i) const { return i < N - n_byz; }
+  // Byz i's per-receiver stance in round r (SPEC §6 equivocate mode).
+  bool sup(uint32_t r, uint32_t i, uint32_t j) const {
+    return random_u32(seed, STREAM_EQUIV, r, i, j) & 1u;
+  }
 
   void run() {
     view.assign(N, 0); timer.assign(N, 0);
@@ -356,11 +361,21 @@ struct PbftSim {
       }
       for (uint32_t j = 0; j < N; ++j) {
         uint32_t prim = view[j] % N;
-        bool ok = (prim == j || net.delivered(prim, j)) && s_view[prim] == view[j];
+        bool prim_byz = equiv && !honest(prim);
+        bool del = prim == j || net.delivered(prim, j);
+        // A byz primary lies about its view, so only delivery gates it;
+        // it offers EVERY slot, per-receiver conflicting values.
+        bool ok = prim_byz ? del : (del && s_view[prim] == view[j]);
         if (!ok) continue;
         for (uint32_t s = 0; s < S; ++s) {
-          if (!s_ppb[at(prim, s)]) continue;
-          uint32_t v = s_msgval[at(prim, s)];
+          uint32_t v;
+          if (prim_byz) {
+            v = random_u32(seed, STREAM_VALUE, view[j],
+                           sup(r, prim, j) ? 4 : 3, s);
+          } else {
+            if (!s_ppb[at(prim, s)]) continue;
+            v = s_msgval[at(prim, s)];
+          }
           if (pp_seen[at(j, s)] && pp_view[at(j, s)] >= view[j]) continue;
           if (prepared[at(j, s)] && v != pp_val[at(j, s)]) continue;
           pp_seen[at(j, s)] = 1;
@@ -375,11 +390,15 @@ struct PbftSim {
         for (uint32_t s = 0; s < S; ++s) {
           if (!s_seen[at(j, s)] || prepared[at(j, s)]) continue;
           uint32_t cnt = 0;
-          for (uint32_t i = 0; i < N; ++i)
+          for (uint32_t i = 0; i < N; ++i) {
             if (honest(i) && s_seen[at(i, s)] &&
                 s_val[at(i, s)] == s_val[at(j, s)] &&
                 (i == j || net.delivered(i, j)))
               ++cnt;
+            else if (equiv && !honest(i) && i != j && net.delivered(i, j) &&
+                     sup(r, i, j))
+              ++cnt;  // byz i claims j's exact value iff its stance coin
+          }
           if (cnt >= Q) prepared[at(j, s)] = 1;
         }
 
@@ -389,11 +408,15 @@ struct PbftSim {
         for (uint32_t s = 0; s < S; ++s) {
           if (!s_prep[at(j, s)] || committed[at(j, s)]) continue;
           uint32_t cnt = 0;
-          for (uint32_t i = 0; i < N; ++i)
+          for (uint32_t i = 0; i < N; ++i) {
             if (honest(i) && s_prep[at(i, s)] &&
                 s_val[at(i, s)] == s_val[at(j, s)] &&
                 (i == j || net.delivered(i, j)))
               ++cnt;
+            else if (equiv && !honest(i) && i != j && net.delivered(i, j) &&
+                     sup(r, i, j))
+              ++cnt;
+          }
           if (cnt >= Q) {
             committed[at(j, s)] = 1;
             dval[at(j, s)] = pp_val[at(j, s)];
@@ -680,6 +703,7 @@ class PbftEngine final : public SlotEngine<PbftSim> {
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity; sim_.f = c.f;
     sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
+    sim_.equiv = c.byz_equivocate;
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
     sim_.run();
@@ -795,7 +819,7 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
 
 int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t n_slots, uint32_t f, uint32_t view_timeout,
-                  uint32_t n_byzantine,
+                  uint32_t n_byzantine, uint32_t byz_equivocate,
                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
                   uint8_t* out_committed,   // [N*S]
                   uint32_t* out_dval,       // [N*S]
@@ -804,6 +828,7 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   ctpu::PbftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
   sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
+  sim.equiv = byz_equivocate;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;

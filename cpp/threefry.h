@@ -18,6 +18,7 @@ constexpr uint32_t STREAM_STAKE     = 0x165667B1u;
 constexpr uint32_t STREAM_VOTE      = 0xD3A2646Cu;
 constexpr uint32_t STREAM_VALUE     = 0xFD7046C5u;
 constexpr uint32_t STREAM_BYZANTINE = 0xB55A4F09u;
+constexpr uint32_t STREAM_EQUIV     = 0x94D049BBu;
 
 inline uint32_t rotl32(uint32_t x, int r) {
   return (x << r) | (x >> (32 - r));

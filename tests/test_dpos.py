@@ -28,15 +28,15 @@ def test_dpos_decided_log_byte_equivalence(cfg):
 
 
 def test_dpos_blocks_come_from_scheduled_producers():
-    """Every chain block's producer must be the scheduled one for its round."""
-    import jax.numpy as jnp
+    """Every chain block's producer must be the scheduled one for its round
+    — in EVERY sweep (each sweep derives its own schedule from seed+b)."""
     from consensus_tpu.engines.dpos import dpos_run, dpos_schedule
+    from consensus_tpu.network.runner import make_seeds
     out = dpos_run(BASE)
-    _, producers, _ = dpos_schedule(BASE, np.uint32(BASE.seed))
-    producers = np.asarray(producers)
+    seeds = make_seeds(BASE)
     for b in range(BASE.n_sweeps):
-        if b != 0:
-            continue  # schedule derived for sweep-0 seed
+        _, producers, _ = dpos_schedule(BASE, np.uint32(seeds[b]))
+        producers = np.asarray(producers)
         for v in range(BASE.n_nodes):
             n = int(out["chain_len"][b, v])
             for k in range(n):

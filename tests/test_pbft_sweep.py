@@ -53,6 +53,23 @@ def test_padded_equals_oracle(sweep, k):
                                   oracle["dval"][c].astype(np.uint32))
 
 
+def test_padded_equivocate_equals_unpadded():
+    """The equivocating adversary must survive padding byte-identically
+    (its draws are keyed by absolute ids, like every other stream)."""
+    base = dataclasses.replace(BASE, n_byzantine=1, byz_mode="equivocate",
+                               churn_rate=0.2)
+    out = pbft_fsweep_run(base, [1, 2])
+    for k, f in enumerate([1, 2]):
+        cfg = dataclasses.replace(base, f=f, n_nodes=3 * f + 1, n_sweeps=1,
+                                  seed=base.seed + k)
+        exact = pbft_run(cfg)
+        np.testing.assert_array_equal(out[k]["committed"],
+                                      exact["committed"][0])
+        c = out[k]["committed"]
+        np.testing.assert_array_equal(out[k]["dval"][c].astype(np.uint32),
+                                      exact["dval"][0][c].astype(np.uint32))
+
+
 def test_liveness_across_fs(sweep):
     # Every element of the sweep must actually commit something under this
     # mild adversary — otherwise the sweep benchmark measures idling.
