@@ -37,6 +37,11 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     # Raft election timeouts, in rounds (randomized per (term, node)).
     t_min: int = 3
     t_max: int = 8
+    # Raft active-sender cap (SPEC §3b). 0 = dense engine (exact [N, N]
+    # bookkeeping); A > 0 = O(A*N) large-population engine: only the top-A
+    # candidates/leaders by (term desc, id asc) send per round, and
+    # replication bookkeeping lives in A tracked-leader slots.
+    max_active: int = 0
 
     # Adversary rates (converted to u32 cutoffs below).
     drop_rate: float = 0.0       # per (round, directed edge) message drop
@@ -79,6 +84,8 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             raise ValueError(f"unknown byz_mode {self.byz_mode!r}")
         if self.t_max <= self.t_min:
             raise ValueError("t_max must exceed t_min")
+        if self.max_active < 0:
+            raise ValueError("max_active must be >= 0 (0 = dense engine)")
 
     # Integer cutoffs — THE values both engines compare draws against.
     @property

@@ -282,6 +282,12 @@ def raft_run(cfg: Config, **kw):
     """Run the full batched simulation. Returns host numpy arrays
     {commit, log_term, log_val, term, role} with leading sweep axis [B, ...].
     Keyword args (mesh=, checkpoint_path=, resume=) pass through to
-    :func:`consensus_tpu.network.runner.run`."""
+    :func:`consensus_tpu.network.runner.run`.
+
+    ``cfg.max_active > 0`` selects the O(A*N) large-population engine
+    (engines/raft_sparse.py, SPEC §3b); 0 selects this dense kernel."""
     from ..network import runner
+    if cfg.max_active > 0:
+        from . import raft_sparse
+        return runner.run(cfg, raft_sparse.get_engine(), **kw)
     return runner.run(cfg, get_engine(), **kw)

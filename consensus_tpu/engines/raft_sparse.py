@@ -1,0 +1,325 @@
+"""Large-population Raft: O(A*N) per round instead of O(N^2) (SPEC §3b).
+
+The dense kernel (engines/raft.py) carries `[N, N]` match/next state and a
+full `[N, N]` delivery mask — 40 GB each at the north star's 100k-node
+scale (BASELINE.json:5), which no chip holds. This engine is the TPU
+answer to SURVEY.md §7's "hard parts" (never materialize full N^2):
+
+  * **Active-sender cap** `A = cfg.max_active`: per round, only the top-A
+    candidates and top-A leaders — ranked by (term desc, id asc) — send
+    messages. Suppressing a sender is indistinguishable from the network
+    dropping its messages, which Raft tolerates by design, so safety is
+    untouched; with randomized timeouts the concurrent-sender count
+    rarely approaches even a small A.
+  * **Leader slots**: replication bookkeeping (`match/next`) lives in A
+    rows of `[A, N]`, owned by the currently tracked leaders. A leader
+    keeps its rows while continuously tracked; on (re-)entry its rows are
+    re-initialized exactly as at election (match = 0 except self,
+    next = log_len + 1).
+  * **Edge-wise delivery** (ops/adversary.delivery_edges): draws evaluated
+    only for the O(A*N) live edges, byte-identical to the dense mask's
+    entries because every draw is keyed by absolute (round, src, dst) ids.
+
+When the concurrent candidate/leader count never exceeds A, this engine's
+decided logs are bit-identical to the dense engine's (tested in
+tests/test_raft_sparse.py); the capped semantics are mirrored scalar-for-
+scalar in the C++ oracle (cpp/oracle.cpp RaftSim with max_active > 0).
+
+Memory at N=100k, L=128, A=8: ~110 MB per sweep instance (logs dominate)
+vs ~80 GB dense — see docs/SCALE.md for the full budget.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core.config import Config
+from ..ops.adversary import bitcast_i32 as _i32
+from ..ops.adversary import delivery_edges as _edges
+from ..ops.adversary import draw as _draw
+from ..ops.adversary import cutoff as _lt
+from .raft import NONE, ROLE_C, ROLE_F, ROLE_L, _draw_timeout, _last_term
+
+I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class RaftSparseState(NamedTuple):
+    seed: jnp.ndarray        # [] uint32
+    term: jnp.ndarray        # [N] i32
+    role: jnp.ndarray        # [N] i32
+    voted_for: jnp.ndarray   # [N] i32
+    log_term: jnp.ndarray    # [N, L] i32
+    log_val: jnp.ndarray     # [N, L] i32
+    log_len: jnp.ndarray     # [N] i32
+    commit: jnp.ndarray      # [N] i32
+    timer: jnp.ndarray       # [N] i32
+    timeout: jnp.ndarray     # [N] i32
+    lead_id: jnp.ndarray     # [A] i32 — tracked leader ids, NONE when empty
+    lead_match: jnp.ndarray  # [A, N] i32
+    lead_next: jnp.ndarray   # [A, N] i32
+
+
+def raft_sparse_init(cfg: Config, seed) -> RaftSparseState:
+    N, L, A = cfg.n_nodes, cfg.log_capacity, cfg.max_active
+    seed = jnp.asarray(seed, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    z = jnp.zeros(N, jnp.int32)
+    return RaftSparseState(
+        seed=seed, term=z, role=z, voted_for=jnp.full(N, NONE, jnp.int32),
+        log_term=jnp.zeros((N, L), jnp.int32),
+        log_val=jnp.zeros((N, L), jnp.int32),
+        log_len=z, commit=z, timer=z,
+        timeout=_draw_timeout(seed, cfg.t_min, cfg.t_max, z,
+                              idx.astype(jnp.uint32)),
+        lead_id=jnp.full(A, NONE, jnp.int32),
+        lead_match=jnp.zeros((A, N), jnp.int32),
+        lead_next=jnp.ones((A, N), jnp.int32),
+    )
+
+
+def _top_active(mask, term, idx, A: int):
+    """Ids of the top-A ``mask`` nodes by (term desc, id asc); NONE-padded.
+
+    The tie-break is lexicographic `lax.sort` on (-term, id): suppressed
+    (non-mask) lanes sort last via an INT32_MAX key.
+    """
+    neg = jnp.where(mask, -term, I32_MAX)
+    key_sorted, ids_sorted = jax.lax.sort((neg, idx), num_keys=2)
+    return jnp.where(key_sorted[:A] != I32_MAX, ids_sorted[:A], NONE)
+
+
+def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
+    """One SPEC §3 round under the §3b active-sender cap. Mirrors the dense
+    kernel phase by phase; every dense [N, N] object becomes [A, N]/[N, A]."""
+    N, L, A = cfg.n_nodes, cfg.log_capacity, cfg.max_active
+    E = min(cfg.max_entries, L)
+    majority = N // 2 + 1
+    seed = st.seed
+    idx = jnp.arange(N, dtype=jnp.int32)
+    uidx = idx.astype(jnp.uint32)
+    ur = jnp.asarray(r, jnp.uint32)
+    karange = jnp.arange(L, dtype=jnp.int32)[None, :]
+
+    def dedge(src, dst):
+        return _edges(seed, ur, src, dst, cfg.drop_cutoff, cfg.partition_cutoff)
+
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+
+    term, role, voted_for = st.term, st.role, st.voted_for
+    log_term, log_val, log_len = st.log_term, st.log_val, st.log_len
+    commit, timer, timeout = st.commit, st.timer, st.timeout
+    lead_id, lead_match, lead_next = st.lead_id, st.lead_match, st.lead_next
+
+    def bump(cond, new_term, term, role, voted_for, timeout):
+        term2 = jnp.where(cond, new_term, term)
+        role2 = jnp.where(cond, ROLE_F, role)
+        vf2 = jnp.where(cond, NONE, voted_for)
+        to2 = jnp.where(cond, _draw_timeout(seed, cfg.t_min, cfg.t_max,
+                                            term2, uidx), timeout)
+        return term2, role2, vf2, to2
+
+    # ---- P0 churn.
+    stepdown = churn & (role == ROLE_L)
+    role = jnp.where(stepdown, ROLE_F, role)
+    timer = jnp.where(stepdown, 0, timer)
+    reset = stepdown
+
+    # ---- P1 candidacy.
+    cand_new = (role != ROLE_L) & (timer >= timeout)
+    term = term + cand_new.astype(jnp.int32)
+    role = jnp.where(cand_new, ROLE_C, role)
+    voted_for = jnp.where(cand_new, idx, voted_for)
+    timer = jnp.where(cand_new, 0, timer)
+    reset |= cand_new
+    timeout = jnp.where(cand_new,
+                        _draw_timeout(seed, cfg.t_min, cfg.t_max, term, uidx),
+                        timeout)
+
+    # ---- P2 election over the active candidate set (SPEC §3b).
+    cand_ids = _top_active(role == ROLE_C, term, idx, A)       # [A]
+    cvalid = cand_ids >= 0
+    cid = jnp.clip(cand_ids, 0, N - 1)
+    req_term = jnp.where(cvalid, term[cid], 0)
+    req_lidx = log_len[cid]
+    req_lterm = _last_term(log_term[cid], log_len[cid])
+    del_cj = dedge(cand_ids[:, None], idx[None, :])            # [A, N]
+
+    # P2a term catch-up.
+    t_in = jnp.max(jnp.where(del_cj, req_term[:, None], 0), axis=0)
+    bumped = t_in > term
+    term, role, voted_for, timeout = bump(bumped, t_in, term, role,
+                                          voted_for, timeout)
+
+    # P2b grants. elig[k, j]: active candidate k's request grantable at j.
+    own_lterm = _last_term(log_term, log_len)
+    up_to_date = (req_lterm[:, None] > own_lterm[None, :]) | (
+        (req_lterm[:, None] == own_lterm[None, :])
+        & (req_lidx[:, None] >= log_len[None, :]))
+    elig = del_cj & (req_term[:, None] == term[None, :]) & up_to_date
+    vmatch = cand_ids[:, None] == voted_for[None, :]           # [A, N]
+    vf_elig = jnp.any(vmatch & elig, axis=0)
+    first_elig = jnp.min(jnp.where(elig, cid[:, None], N), axis=0)
+    grant = jnp.where(
+        vf_elig, voted_for,
+        jnp.where((voted_for == NONE) & (first_elig < N), first_elig, NONE))
+    granted = grant >= 0
+    voted_for = jnp.where(granted, grant, voted_for)
+    timer = jnp.where(granted, 0, timer)
+    reset |= granted
+
+    # P2c tally per active candidate; winners become leaders.
+    del_jc = dedge(idx[:, None], cand_ids[None, :])            # [N, A]
+    votes = 1 + jnp.sum((grant[:, None] == cand_ids[None, :]) & del_jc,
+                        axis=0, dtype=jnp.int32)               # [A]
+    win = cvalid & (role[cid] == ROLE_C) & (votes >= majority)
+    win_id = jnp.where(win, cid, N)                            # N ⇒ dropped
+    role = role.at[win_id].set(ROLE_L, mode="drop")
+    timer = timer.at[win_id].set(0, mode="drop")
+    reset = reset.at[win_id].set(True, mode="drop")
+
+    # ---- Tracked-leader slot lifecycle (SPEC §3b): rows follow ids;
+    # entries (new winners or re-entries) get fresh election-time rows.
+    new_ids = _top_active(role == ROLE_L, term, idx, A)        # [A]
+    same = new_ids[:, None] == jnp.where(lead_id[None, :] >= 0,
+                                         lead_id[None, :], N + 1)  # [A, A]
+    carried = jnp.any(same, axis=1) & (new_ids >= 0)
+    src_slot = jnp.argmax(same, axis=1)
+    nid = jnp.clip(new_ids, 0, N - 1)
+    init_match = jnp.where(idx[None, :] == nid[:, None],
+                           log_len[nid][:, None], 0)           # [A, N]
+    init_next = (log_len[nid][:, None] + 1) * jnp.ones((A, N), jnp.int32)
+    lead_match = jnp.where(carried[:, None], lead_match[src_slot], init_match)
+    lead_next = jnp.where(carried[:, None], lead_next[src_slot], init_next)
+    lead_id = new_ids
+    lvalid = lead_id >= 0
+    lid = jnp.clip(lead_id, 0, N - 1)
+
+    # ---- P3a propose (every leader, tracked or not — local append only).
+    lead = role == ROLE_L
+    can_prop = lead & (log_len < E)
+    slot_hot = (karange == log_len[:, None]) & can_prop[:, None]
+    prop_val = _i32(_draw(seed, rng.STREAM_VALUE, ur, 0, uidx))
+    log_term = jnp.where(slot_hot, term[:, None], log_term)
+    log_val = jnp.where(slot_hot, prop_val[:, None], log_val)
+    log_len = log_len + can_prop.astype(jnp.int32)
+    # Tracked leaders' self-match follows their own append.
+    self_pos = jnp.where(lvalid & can_prop[lid], lid, N)
+    lead_match = lead_match.at[jnp.arange(A), self_pos].set(
+        log_len[lid], mode="drop")
+
+    # ---- P3b snapshot tracked-sender state.
+    was_lead_k = lvalid & lead[lid]
+    s_term, s_len, s_commit = term[lid], log_len[lid], commit[lid]
+    s_next = lead_next
+    s_logt, s_logv = log_term[lid], log_val[lid]               # [A, L]
+
+    # ---- P3c receivers.
+    del_lj = dedge(jnp.where(was_lead_k, lead_id, NONE)[:, None],
+                   idx[None, :])                               # [A, N]
+    t_in2 = jnp.max(jnp.where(del_lj, s_term[:, None], 0), axis=0)
+    bumped2 = t_in2 > term
+    term, role, voted_for, timeout = bump(bumped2, t_in2, term, role,
+                                          voted_for, timeout)
+
+    valid = del_lj & (s_term[:, None] == term[None, :])        # [A, N]
+    lstar = jnp.min(jnp.where(valid, lid[:, None], N), axis=0)  # [N] node id
+    has_l = lstar < N
+    kstar = jnp.argmin(jnp.where(valid, lid[:, None], N), axis=0)  # [N] slot
+
+    timer = jnp.where(has_l, 0, timer)
+    reset |= has_l
+    role = jnp.where(has_l & (role == ROLE_C), ROLE_F, role)
+
+    prev = s_next[kstar, idx] - 1                              # [N]
+    lrow_t = s_logt[kstar]                                     # [N, L]
+    lrow_v = s_logv[kstar]
+    kprev = jnp.clip(prev - 1, 0, L - 1)[:, None]
+    prev_term_l = jnp.where(prev > 0,
+                            jnp.take_along_axis(lrow_t, kprev, axis=1)[:, 0], 0)
+    own_at_prev = jnp.where((prev > 0) & (prev <= log_len),
+                            jnp.take_along_axis(log_term, kprev, axis=1)[:, 0],
+                            0)
+    ok = (prev == 0) | ((prev <= log_len) & (own_at_prev == prev_term_l))
+    apply_ = has_l & ok
+
+    l_len = s_len[kstar]
+    copy_mask = apply_[:, None] & (karange >= prev[:, None]) \
+        & (karange < l_len[:, None])
+    log_term = jnp.where(copy_mask, lrow_t, log_term)
+    log_val = jnp.where(copy_mask, lrow_v, log_val)
+    log_len = jnp.where(apply_, l_len, log_len)
+    commit = jnp.where(
+        apply_, jnp.maximum(commit, jnp.minimum(s_commit[kstar], log_len)),
+        commit)
+    ack_slot = jnp.where(has_l, kstar, A)                      # A ⇒ no ack
+    ack_ok = apply_
+    ack_match = jnp.where(apply_, l_len, 0)
+    ack_term = term
+
+    # ---- P3d tracked leaders process acks.
+    still_lead_k = was_lead_k & (role[lid] == ROLE_L)
+    del_jl = dedge(idx[:, None], jnp.where(was_lead_k, lead_id, NONE)[None, :])
+    ackm = (ack_slot[:, None] == jnp.arange(A)[None, :]) & del_jl  # [N, A]
+    t_in3 = jnp.max(jnp.where(ackm, ack_term[:, None], 0), axis=0)  # [A]
+    bump3_k = still_lead_k & (t_in3 > term[lid])
+    bump3_id = jnp.where(bump3_k, lid, N)
+    new_t = term.at[bump3_id].max(t_in3, mode="drop")
+    bumped3 = new_t > term
+    term, role, voted_for, timeout = bump(bumped3, new_t, term, role,
+                                          voted_for, timeout)
+    proc = still_lead_k & ~bump3_k                             # [A]
+
+    succ_kj = (ackm & ack_ok[:, None]).T                       # [A, N]
+    fail_kj = (ackm & ~ack_ok[:, None]).T
+    lead_match = jnp.where(proc[:, None] & succ_kj,
+                           jnp.maximum(lead_match, ack_match[None, :]),
+                           lead_match)
+    lead_next = jnp.where(
+        proc[:, None] & succ_kj, lead_match + 1,
+        jnp.where(proc[:, None] & fail_kj,
+                  jnp.maximum(1, lead_next - 1), lead_next))
+
+    # ---- P3e commit advance: majority-th largest of each tracked row.
+    med = jnp.sort(lead_match, axis=1)[:, N - majority]        # [A]
+    kmed = jnp.clip(med - 1, 0, L - 1)
+    term_at_med = log_term[lid, kmed]
+    adv = proc & (med > commit[lid]) & (med > 0) & (term_at_med == term[lid])
+    adv_id = jnp.where(adv, lid, N)
+    commit = commit.at[adv_id].max(med, mode="drop")
+
+    # ---- P4 timers.
+    timer = jnp.where(role == ROLE_L, 0, jnp.where(reset, timer, timer + 1))
+
+    return RaftSparseState(seed, term, role, voted_for, log_term, log_val,
+                           log_len, commit, timer, timeout, lead_id,
+                           lead_match, lead_next)
+
+
+def _extract(st: RaftSparseState) -> dict:
+    return {"commit": st.commit, "log_term": st.log_term,
+            "log_val": st.log_val, "term": st.term, "role": st.role}
+
+
+def _pspec(cfg: Config) -> RaftSparseState:
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import NODE_AXIS as ND
+    v, m = P(ND), P(ND, None)
+    lm = P(None, ND)
+    return RaftSparseState(seed=P(), term=v, role=v, voted_for=v, log_term=m,
+                           log_val=m, log_len=v, commit=v, timer=v, timeout=v,
+                           lead_id=P(), lead_match=lm, lead_next=lm)
+
+
+_ENGINE = None
+
+
+def get_engine():
+    global _ENGINE
+    if _ENGINE is None:
+        from ..network.runner import EngineDef
+        _ENGINE = EngineDef("raft-sparse", raft_sparse_init, raft_sparse_round,
+                            _extract, _pspec)
+    return _ENGINE

@@ -55,3 +55,25 @@ def delivery(seed, N: int, r, drop_cut: int, part_cut: int):
 def churn(seed, r, churn_cut: int):
     """SPEC §2: True iff the per-round leader-churn event fires."""
     return draw(seed, rng.STREAM_CHURN, r, 0, 0) < cutoff(churn_cut)
+
+
+def delivery_edges(seed, r, src, dst, drop_cut: int, part_cut: int):
+    """SPEC §2 delivery evaluated on explicit (src, dst) edge id arrays.
+
+    Broadcasts ``src`` against ``dst`` (e.g. src [A, 1] x dst [1, N]) and
+    returns the delivery mask for exactly those edges. Draw keys are the
+    absolute (round, src id, dst id) — identical to the full [N, N] mask's
+    entries, so evaluating only live edges (the large-N engines' O(A*N)
+    path, SURVEY.md §7 "never materialize full N^2") is byte-invisible.
+    Negative ids are allowed (masked-out lanes) and return False.
+    """
+    valid = (src >= 0) & (dst >= 0)
+    usrc = jnp.asarray(src, jnp.int32).astype(jnp.uint32)
+    udst = jnp.asarray(dst, jnp.int32).astype(jnp.uint32)
+    dropped = draw(seed, rng.STREAM_DELIVER, r, usrc, udst) < cutoff(drop_cut)
+    part_active = draw(seed, rng.STREAM_PARTITION, r, 0, 0) < cutoff(part_cut)
+    side_s = draw(seed, rng.STREAM_PARTITION, r, 1, usrc) & jnp.uint32(1)
+    side_d = draw(seed, rng.STREAM_PARTITION, r, 1, udst) & jnp.uint32(1)
+    same_side = side_s == side_d
+    off_diag = usrc != udst
+    return valid & (~dropped) & (same_side | ~part_active) & off_diag
