@@ -1,0 +1,182 @@
+"""Profile the flagship raft-1024x1024 kernel on the chip (VERDICT r3 #1).
+
+Two outputs:
+  * a jax.profiler trace (Perfetto) under benchmarks/traces/<tag>/ for
+    offline inspection (steady-state only — the compile is excluded);
+  * an ablation table on stderr: wall-clock of the full round kernel vs
+    variants with one component disabled, measured on the same shapes.
+    The deltas localize time sinks without a trace viewer (no GUI here).
+
+Current ablations (vs the CURRENT kernel):
+  * "cheap delivery" — replaces the SPEC §2 delivery mixer with one draw
+    broadcast to all edges: the remaining cost of delivery randomness.
+  * "timers only"    — P0+P1+P4 only: the non-[N,N] floor.
+
+The historical round-4 attribution quoted in docs/PERF.md (commit-sort
+45.1%, delivery *threefry* 24.3%) was measured with this script against
+the PRE-optimization kernel (jnp.sort commit advance + threefry
+delivery); those two components no longer exist in the committed kernel,
+so those numbers are not reproducible from HEAD — that is the point of
+the optimization. The ablated kernels are *wrong* (they skip protocol
+semantics) — they exist only to attribute time; nothing here feeds the
+differential tests.
+
+Usage: python benchmarks/profile_raft.py [--nodes 1024] [--rounds 256]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from consensus_tpu.utils.platform import ensure_platform
+
+ensure_platform("auto")
+
+import jax
+import jax.numpy as jnp
+
+from consensus_tpu.core import rng
+from consensus_tpu.core.config import Config
+from consensus_tpu.engines import raft
+from consensus_tpu.network import runner
+
+
+def log(msg):
+    print(f"profile: {msg}", file=sys.stderr, flush=True)
+
+
+def timed_scan(cfg, round_fn, seeds, n_rounds, tag, repeats=3,
+               trace_dir=None):
+    """Scan `round_fn` (cfg-bound) over n_rounds, vmapped over sweeps."""
+
+    @jax.jit
+    def prog(seeds):
+        carry = jax.vmap(lambda s: raft.raft_init(cfg, s))(seeds)
+
+        def body(c, r):
+            return jax.vmap(lambda s: round_fn(cfg, s, r))(c), None
+
+        carry, _ = jax.lax.scan(body, carry,
+                                jnp.arange(n_rounds, dtype=jnp.int32))
+        return carry
+
+    import numpy as np
+
+    def sync(o):
+        # The axon tunnel's block_until_ready is a no-op (experimental
+        # plugin); a host transfer is the only reliable barrier.
+        return np.asarray(o.commit).sum()
+
+    sync(prog(seeds))  # compile + warm
+    if trace_dir is not None:
+        # Trace only a steady-state execution — tracing the compile
+        # drowns the device timeline in host-side jaxpr events.
+        with jax.profiler.trace(str(trace_dir)):
+            sync(prog(seeds))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        sync(prog(seeds))
+        best = min(best, time.perf_counter() - t0)
+    steps = seeds.shape[0] * cfg.n_nodes * n_rounds
+    log(f"{tag:28s} {best:7.3f}s  {steps / best / 1e6:7.2f}M steps/s")
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1024)
+    ap.add_argument("--rounds", type=int, default=256)
+    ap.add_argument("--sweeps", type=int, default=8)
+    ap.add_argument("--trace", action="store_true",
+                    help="also capture a jax.profiler trace of the full kernel")
+    args = ap.parse_args()
+
+    cfg = Config(protocol="raft", engine="tpu", n_nodes=args.nodes,
+                 n_rounds=args.rounds, n_sweeps=args.sweeps,
+                 log_capacity=128, max_entries=112,
+                 drop_rate=0.01, churn_rate=0.001, seed=42)
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    log(f"device={jax.devices()[0]} N={args.nodes} R={args.rounds} "
+        f"S={args.sweeps}")
+
+    # --- full kernel ---------------------------------------------------
+    t_full = timed_scan(cfg, raft.raft_round, seeds, args.rounds, "full")
+
+    t_nodel = timed_scan(cfg, _cheap_delivery_round, seeds, args.rounds,
+                         "cheap delivery (ablate mixer)")
+    t_nomsg = timed_scan(cfg, _timers_only_round, seeds, args.rounds,
+                         "timers only (no [N,N])")
+
+    log("--- attribution (deltas vs full) ---")
+    log(f"delivery mixer       : {t_full - t_nodel:7.3f}s "
+        f"({100 * (t_full - t_nodel) / t_full:4.1f}%)")
+    log(f"all [N,N] phases     : {t_full - t_nomsg:7.3f}s "
+        f"({100 * (t_full - t_nomsg) / t_full:4.1f}%)")
+
+    if args.trace:
+        import pathlib
+        tdir = pathlib.Path(__file__).parent / "traces" / \
+            f"raft{args.nodes}x{args.rounds}"
+        tdir.mkdir(parents=True, exist_ok=True)
+        timed_scan(cfg, raft.raft_round, seeds, min(args.rounds, 64),
+                   "traced", repeats=1, trace_dir=tdir)
+        log(f"trace written to {tdir}")
+
+
+# --- ablated round variants (wrong on purpose; timing only) ---------------
+
+def _cheap_delivery_round(cfg, st, r):
+    """Full round but the [N,N] delivery mask uses ONE threefry draw
+    broadcast to all edges — isolates the per-edge draw cost (the SPEC
+    mixer at HEAD; threefry before round 4)."""
+    from consensus_tpu.ops import adversary
+    orig = adversary.delivery
+
+    def cheap(seed, N, rr, drop_cut, part_cut):
+        one = rng.random_u32_jnp(seed, rng.STREAM_DELIVER, rr, 0, 0)
+        i = jnp.arange(N, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(N, dtype=jnp.uint32)[None, :]
+        bit = ((one >> (i * 7 + j) % 32) & 1).astype(bool)
+        return bit | (i != j)
+
+    try:
+        adversary.delivery = cheap
+        raft._delivery = cheap
+        return raft.raft_round(cfg, st, r)
+    finally:
+        adversary.delivery = orig
+        raft._delivery = orig
+
+
+def _timers_only_round(cfg, st, r):
+    """P0+P1+P4 only — no message exchange at all. Lower bound for the
+    non-[N,N] part of the kernel."""
+    N = cfg.n_nodes
+    idx = jnp.arange(N, dtype=jnp.int32)
+    uidx = idx.astype(jnp.uint32)
+    ur = jnp.asarray(r, jnp.uint32)
+    seed = st.seed
+    churn = raft._draw(seed, rng.STREAM_CHURN, ur, 0, 0) < raft._lt(
+        cfg.churn_cutoff)
+    term, role, voted_for = st.term, st.role, st.voted_for
+    timer, timeout = st.timer, st.timeout
+    stepdown = churn & (role == raft.ROLE_L)
+    role = jnp.where(stepdown, raft.ROLE_F, role)
+    timer = jnp.where(stepdown, 0, timer)
+    cand_new = (role != raft.ROLE_L) & (timer >= timeout)
+    term = term + cand_new.astype(jnp.int32)
+    role = jnp.where(cand_new, raft.ROLE_C, role)
+    voted_for = jnp.where(cand_new, idx, voted_for)
+    timer = jnp.where(cand_new | stepdown, 0, timer + 1)
+    timeout = jnp.where(cand_new,
+                        raft._draw_timeout(seed, cfg.t_min, cfg.t_max, term,
+                                           uidx), timeout)
+    return raft.RaftState(seed, term, role, voted_for, st.log_term,
+                          st.log_val, st.log_len, st.commit, timer, timeout,
+                          st.match_idx, st.next_idx)
+
+
+if __name__ == "__main__":
+    main()

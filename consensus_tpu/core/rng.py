@@ -143,6 +143,93 @@ def random_u32_jnp(seed, stream, ctx, c0, c1):
     return y0
 
 
+# --- delivery mixer ---------------------------------------------------------
+#
+# The per-edge delivery drop draw is the single highest-volume random
+# decision in the simulator: N^2 draws per round per sweep (8.6e9 u32
+# words for the flagship raft-1024x1024x8 run). At that volume the
+# 20-round Threefry schedule is ~25% of the whole TPU round kernel
+# (benchmarks/profile_raft.py ablation, 2026-07-29). SPEC §2 therefore
+# draws STREAM_DELIVER words from a MurmurHash3-style absorb/finalize
+# mixer (Appleby, public domain; ~15 VPU ops/edge after hoisting vs ~110
+# for threefry). Every other stream (timeout, churn, partition, value,
+# stake, vote, byzantine, equivocation) is O(N) or O(1) per round and
+# stays on Threefry. The mixer is implemented three times (numpy here,
+# jnp below, scalar C++ in cpp/threefry.h) and cross-validated in
+# tests/test_rng.py + tests/test_oracle_bindings.py; its avalanche
+# quality is sanity-checked in tests/test_rng.py (bit-flip balance).
+#
+# Chain (all u32, wrapping):
+#   h = absorb(absorb(absorb(lo32(seed) ^ STREAM_DELIVER, r), i), j)
+#   delivery_u32 = fmix(h)
+# absorb(h, c) = rotl(h ^ (rotl(c*0xCC9E2D51, 15) * 0x1B873593), 13) * 5
+#                + 0xE6546B64
+# fmix(h): h ^= h>>16; h *= 0x85EBCA6B; h ^= h>>13; h *= 0xC2B2AE35;
+#          h ^= h>>16  (murmur3 finalizer — full avalanche)
+
+_MIX_C1 = np.uint32(0xCC9E2D51)
+_MIX_C2 = np.uint32(0x1B873593)
+_MIX_C3 = np.uint32(0xE6546B64)
+_FMIX_A = np.uint32(0x85EBCA6B)
+_FMIX_B = np.uint32(0xC2B2AE35)
+
+
+def mix_absorb_np(h, c):
+    with np.errstate(over="ignore"):
+        h = np.asarray(h, np.uint32)
+        k = (np.asarray(c, np.uint32) * _MIX_C1).astype(np.uint32)
+        k = (_rotl32_np(k, 15) * _MIX_C2).astype(np.uint32)
+        h, k = np.broadcast_arrays(h, k)
+        h = _rotl32_np(h.astype(np.uint32) ^ k, 13)
+        return (h * np.uint32(5) + _MIX_C3).astype(np.uint32)
+
+
+def mix_fin_np(h):
+    with np.errstate(over="ignore"):
+        h = np.asarray(h, np.uint32)
+        h = h ^ (h >> np.uint32(16))
+        h = (h * _FMIX_A).astype(np.uint32)
+        h = h ^ (h >> np.uint32(13))
+        h = (h * _FMIX_B).astype(np.uint32)
+        return h ^ (h >> np.uint32(16))
+
+
+def delivery_u32_np(seed, r, i, j):
+    """SPEC §2 per-edge delivery draw (numpy). Broadcasts over all args."""
+    k0 = ((np.asarray(seed, np.uint64) & np.uint64(0xFFFFFFFF))
+          .astype(np.uint32) ^ STREAM_DELIVER)
+    h = mix_absorb_np(k0, r)
+    return mix_fin_np(mix_absorb_np(mix_absorb_np(h, i), j))
+
+
+def mix_absorb_jnp(h, c):
+    h = jnp.asarray(h, jnp.uint32)
+    k = jnp.asarray(c, jnp.uint32) * jnp.uint32(_MIX_C1)
+    k = _rotl32_jnp(k, 15) * jnp.uint32(_MIX_C2)
+    h = _rotl32_jnp(h ^ k, 13)
+    return h * jnp.uint32(5) + jnp.uint32(_MIX_C3)
+
+
+def mix_fin_jnp(h):
+    h = h ^ jnp.right_shift(h, jnp.uint32(16))
+    h = h * jnp.uint32(_FMIX_A)
+    h = h ^ jnp.right_shift(h, jnp.uint32(13))
+    h = h * jnp.uint32(_FMIX_B)
+    return h ^ jnp.right_shift(h, jnp.uint32(16))
+
+
+def delivery_u32_jnp(seed, r, i, j):
+    """Traceable twin of :func:`delivery_u32_np`. ``seed`` may be traced.
+
+    Call sites that evaluate many edges should hoist the prefix:
+    ``mix_absorb_jnp`` over (seed-key, r) is per-round, over i per-row —
+    only the j-absorb and the finalizer are per-edge.
+    """
+    k0 = jnp.asarray(seed).astype(jnp.uint32) ^ jnp.uint32(int(STREAM_DELIVER))
+    h = mix_absorb_jnp(k0, r)
+    return mix_fin_jnp(mix_absorb_jnp(mix_absorb_jnp(h, i), j))
+
+
 def prob_threshold_u32(p: float) -> int:
     """Integer cutoff for probability ``p``: draw < cutoff ⇔ event fires.
 

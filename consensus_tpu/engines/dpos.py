@@ -53,7 +53,7 @@ def _producer_delivery(cfg: Config, seed, r, p):
     v_idx = jnp.arange(V, dtype=jnp.uint32)
     ur = jnp.asarray(r, jnp.uint32)
     up = jnp.asarray(p, jnp.uint32)
-    dropped = (_draw(seed, rng.STREAM_DELIVER, ur, up, v_idx)
+    dropped = (rng.delivery_u32_jnp(seed, ur, up, v_idx)
                < _lt(cfg.drop_cutoff))
     part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
                    < _lt(cfg.partition_cutoff))

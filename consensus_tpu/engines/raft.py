@@ -238,8 +238,22 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
         proc[:, None] & succ_lj, match_idx + 1,
         jnp.where(proc[:, None] & fail_lj, jnp.maximum(1, next_idx - 1), next_idx))
 
-    # ---- P3e commit advance: majority-th largest of match_idx row.
-    med = jnp.sort(match_idx, axis=1)[:, N - majority]
+    # ---- P3e commit advance: majority-th largest of match_idx row,
+    # i.e. the largest m with |{j : match_idx[l,j] >= m}| >= majority.
+    # Computed by a fixed-depth binary search over the value range [0, L]
+    # (match_idx <= log_len <= L): ~log2(L) masked [N,N] count-reductions
+    # instead of a full [N,N] jnp.sort — same value bit-for-bit, ~10x
+    # fewer VPU ops (the sort was 45% of the round pre-optimization;
+    # docs/PERF.md "Round-4 attribution").
+    lo = jnp.zeros(N, jnp.int32)            # count_ge(0) = N >= majority
+    hi = jnp.full(N, L + 1, jnp.int32)      # count_ge(L+1) = 0 < majority
+    for _ in range((L + 1).bit_length()):   # halves [lo, hi) to width 1
+        mid = (lo + hi) // 2
+        cnt = jnp.sum((match_idx >= mid[:, None]).astype(jnp.int32), axis=1)
+        ok = cnt >= majority
+        lo = jnp.where(ok, mid, lo)
+        hi = jnp.where(ok, hi, mid)
+    med = lo
     kmed = jnp.clip(med - 1, 0, L - 1)[:, None]
     term_at_med = jnp.take_along_axis(log_term, kmed, axis=1)[:, 0]
     adv = proc & (med > commit) & (med > 0) & (term_at_med == term)

@@ -39,11 +39,15 @@ def delivery(seed, N: int, r, drop_cut: int, part_cut: int):
     """SPEC §2: [i, j] True iff a message i→j is delivered in round r.
 
     Composition of per-edge drops, an optional per-round bipartition
-    (nodes on different sides can't talk), and no self-delivery.
+    (nodes on different sides can't talk), and no self-delivery. The
+    drop draw is the SPEC §2 murmur-style mixer (see core.rng delivery
+    mixer notes); the absorb chain hoists itself through broadcasting —
+    (seed, r) is a scalar, the i-absorb is [N, 1] — so only the
+    j-absorb + finalizer touch all N^2 edges.
     """
     i = jnp.arange(N, dtype=jnp.uint32)[:, None]
     j = jnp.arange(N, dtype=jnp.uint32)[None, :]
-    dropped = draw(seed, rng.STREAM_DELIVER, r, i, j) < cutoff(drop_cut)
+    dropped = rng.delivery_u32_jnp(seed, r, i, j) < cutoff(drop_cut)
     part_active = draw(seed, rng.STREAM_PARTITION, r, 0, 0) < cutoff(part_cut)
     side = (draw(seed, rng.STREAM_PARTITION, r, 1, jnp.arange(N, dtype=jnp.uint32))
             & jnp.uint32(1))
@@ -70,7 +74,7 @@ def delivery_edges(seed, r, src, dst, drop_cut: int, part_cut: int):
     valid = (src >= 0) & (dst >= 0)
     usrc = jnp.asarray(src, jnp.int32).astype(jnp.uint32)
     udst = jnp.asarray(dst, jnp.int32).astype(jnp.uint32)
-    dropped = draw(seed, rng.STREAM_DELIVER, r, usrc, udst) < cutoff(drop_cut)
+    dropped = rng.delivery_u32_jnp(seed, r, usrc, udst) < cutoff(drop_cut)
     part_active = draw(seed, rng.STREAM_PARTITION, r, 0, 0) < cutoff(part_cut)
     side_s = draw(seed, rng.STREAM_PARTITION, r, 1, usrc) & jnp.uint32(1)
     side_d = draw(seed, rng.STREAM_PARTITION, r, 1, udst) & jnp.uint32(1)

@@ -37,6 +37,8 @@ def get_lib() -> ctypes.CDLL:
     p32 = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
     lib.ctpu_random_u32.restype = u32
     lib.ctpu_random_u32.argtypes = [u64, u32, u32, u32, u32]
+    lib.ctpu_delivery_u32.restype = u32
+    lib.ctpu_delivery_u32.argtypes = [u64, u32, u32, u32]
     lib.ctpu_raft_run.restype = ctypes.c_int
     lib.ctpu_raft_run.argtypes = [u64] + [u32] * 9 + [p32] * 5
     p8 = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
@@ -52,6 +54,11 @@ def get_lib() -> ctypes.CDLL:
 
 def random_u32(seed: int, stream: int, ctx: int, c0: int, c1: int) -> int:
     return int(get_lib().ctpu_random_u32(seed, stream, ctx, c0, c1))
+
+
+def delivery_u32(seed: int, r: int, i: int, j: int) -> int:
+    """SPEC §2 delivery-mixer draw (C++ twin), for parity tests."""
+    return int(get_lib().ctpu_delivery_u32(seed, r, i, j))
 
 
 def raft_run(cfg, sweep: int = 0):

@@ -44,13 +44,17 @@ struct Net {
     if (part_active)
       for (uint32_t i = 0; i < n; ++i)
         side[i] = random_u32(seed, STREAM_PARTITION, r, 1, i) & 1u;
-    for (uint32_t i = 0; i < n; ++i)
+    const uint32_t hr = mix_absorb(
+        static_cast<uint32_t>(seed & 0xFFFFFFFFull) ^ STREAM_DELIVER, r);
+    for (uint32_t i = 0; i < n; ++i) {
+      const uint32_t hi = mix_absorb(hr, i);
       for (uint32_t j = 0; j < n; ++j) {
         if (i == j) continue;
-        if (random_u32(seed, STREAM_DELIVER, r, i, j) < drop_cut) continue;
+        if (mix_fin(mix_absorb(hi, j)) < drop_cut) continue;
         if (part_active && side[i] != side[j]) continue;
         mat[size_t(i) * n + j] = 1;
       }
+    }
   }
   bool delivered(uint32_t i, uint32_t j) const {
     return mat[size_t(i) * n + j] != 0;
@@ -618,7 +622,7 @@ struct DposSim {
         if (v == p) {
           recv = true;
         } else {
-          recv = random_u32(seed, STREAM_DELIVER, r, p, v) >= drop_cut;
+          recv = delivery_u32(seed, r, p, v) >= drop_cut;
           if (recv && part_active)
             recv = (random_u32(seed, STREAM_PARTITION, r, 1, v) & 1u) == side_p;
         }
@@ -887,6 +891,11 @@ int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
 uint32_t ctpu_random_u32(uint64_t seed, uint32_t stream, uint32_t ctx,
                          uint32_t c0, uint32_t c1) {
   return ctpu::random_u32(seed, stream, ctx, c0, c1);
+}
+
+// Delivery-mixer probe (SPEC §2) for cross-language RNG parity tests.
+uint32_t ctpu_delivery_u32(uint64_t seed, uint32_t r, uint32_t i, uint32_t j) {
+  return ctpu::delivery_u32(seed, r, i, j);
 }
 
 }  // extern "C"

@@ -53,4 +53,32 @@ inline uint32_t random_u32(uint64_t seed, uint32_t stream, uint32_t ctx,
   return threefry2x32(k0, ctx, c0, c1).v0;
 }
 
+// --- SPEC §2 delivery mixer (MurmurHash3-style absorb/finalize) -----------
+// The per-edge delivery drop draw is N^2 per round — the one stream hot
+// enough that the 20-round threefry schedule dominates the TPU kernel
+// (benchmarks/profile_raft.py). Scalar twin of core/rng.py
+// delivery_u32_np; cross-validated in tests/test_oracle_bindings.py.
+inline uint32_t mix_absorb(uint32_t h, uint32_t c) {
+  uint32_t k = c * 0xCC9E2D51u;
+  k = rotl32(k, 15) * 0x1B873593u;
+  h = rotl32(h ^ k, 13);
+  return h * 5u + 0xE6546B64u;
+}
+
+inline uint32_t mix_fin(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  return h ^ (h >> 16);
+}
+
+// delivery_u32(seed, r, i, j) — the SPEC §2 drop draw for edge i->j.
+// Callers looping over edges should hoist the (seed, r) and i absorbs.
+inline uint32_t delivery_u32(uint64_t seed, uint32_t r, uint32_t i,
+                             uint32_t j) {
+  uint32_t k0 = static_cast<uint32_t>(seed & 0xFFFFFFFFull) ^ STREAM_DELIVER;
+  return mix_fin(mix_absorb(mix_absorb(mix_absorb(k0, r), i), j));
+}
+
 }  // namespace ctpu

@@ -14,3 +14,13 @@ def test_threefry_cpp_matches_numpy():
         a = bindings.random_u32(seed, int(stream), ctx, c0, c1)
         b = int(rng.random_u32_np(seed, stream, ctx, c0, c1))
         assert a == b
+
+
+def test_delivery_mixer_cpp_matches_numpy():
+    r = np.random.RandomState(11)
+    for _ in range(50):
+        seed = int(r.randint(0, 2**63, dtype=np.int64))
+        rr, i, j = (int(x) for x in r.randint(0, 2**32, size=3, dtype=np.uint32))
+        a = bindings.delivery_u32(seed, rr, i, j)
+        b = int(rng.delivery_u32_np(seed, rr, i, j))
+        assert a == b
