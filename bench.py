@@ -1,11 +1,12 @@
 """Driver benchmark: one JSON line on stdout, always (rc 0 even on failure).
 
-Flagship config: the Raft 1k-node x 1k-round batched log-match sweep
-(BASELINE.md config 2) on the real TPU chip. Metric is
-node-round-steps/sec (BASELINE.json:2); ``vs_baseline`` is the ratio
-against the driver's north-star target of 10M steps/sec/chip
-(BASELINE.json:5 — the reference publishes no numbers of its own,
-BASELINE.json:13, so the target is the only defined baseline).
+Flagship config: the NORTH-STAR scale — a 100k-node x 64-round x 8-sweep
+Raft run under the SPEC §3b active-sender cap (BASELINE.json:5 defines
+the ≥10M steps/sec/chip target on "100k-node Raft+PBFT sweeps"; the
+dense 1k×1k config remains benchmarked in benchmarks/RESULTS.json).
+Metric is node-round-steps/sec (BASELINE.json:2); ``vs_baseline`` is the
+ratio against the 10M steps/sec/chip target (the reference publishes no
+numbers of its own, BASELINE.json:13).
 
 Robustness (VERDICT.md round 1, weak #1): the TPU backend (axon tunnel)
 can hang or be UNAVAILABLE. Backend init is therefore probed in a
@@ -40,9 +41,11 @@ def emit(obj: dict) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--nodes", type=int, default=1024)
-    ap.add_argument("--rounds", type=int, default=1024)
+    ap.add_argument("--nodes", type=int, default=100_000)
+    ap.add_argument("--rounds", type=int, default=64)
     ap.add_argument("--sweeps", type=int, default=8)
+    ap.add_argument("--max-active", type=int, default=8,
+                    help="SPEC §3b active-sender cap (0 = dense engine)")
     ap.add_argument("--log-capacity", type=int, default=128)
     ap.add_argument("--drop-rate", type=float, default=0.01)
     ap.add_argument("--churn-rate", type=float, default=0.001)
@@ -64,14 +67,16 @@ def main() -> None:
     plat_tag = ensure_platform("auto", probe_timeout=args.probe_timeout,
                                retries=args.probe_retries)
     if plat_tag.startswith("cpu"):
-        # Still produce a number, on a smaller round count; the metric
-        # name says so explicitly (honest labeling).
+        # Still produce a number, on a smaller shape; the metric name
+        # says so explicitly (honest labeling).
         args.rounds = min(args.rounds, args.cpu_fallback_rounds)
-        log(f"CPU fallback; rounds -> {args.rounds}")
+        args.nodes = min(args.nodes, 4096)
+        log(f"CPU fallback; rounds -> {args.rounds}, nodes -> {args.nodes}")
     else:
         log(f"accelerator ok, platform={plat_tag}")
 
-    metric = (f"raft-{args.nodes}node-{args.rounds}round "
+    cap = f"-cap{args.max_active}" if args.max_active else ""
+    metric = (f"raft-{args.nodes}node-{args.rounds}round{cap} "
               f"node-round-steps/sec [{plat_tag}]")
 
     def on_timeout():
@@ -96,7 +101,6 @@ def run_benchmark(args, metric: str) -> None:
     import numpy as np
 
     from consensus_tpu.core.config import Config
-    from consensus_tpu.engines import raft
     from consensus_tpu.network import runner
 
     dev = jax.devices()[0]
@@ -107,10 +111,12 @@ def run_benchmark(args, metric: str) -> None:
         n_nodes=args.nodes, n_rounds=args.rounds, n_sweeps=args.sweeps,
         log_capacity=args.log_capacity,
         max_entries=max(1, args.log_capacity - 16),
+        max_active=args.max_active,
         drop_rate=args.drop_rate, churn_rate=args.churn_rate, seed=42,
     )
     steps = cfg.n_sweeps * cfg.n_nodes * cfg.n_rounds
-    eng = raft.get_engine()
+    from consensus_tpu.network import simulator
+    eng = simulator.engine_def(cfg)
 
     t0 = time.perf_counter()
     carry = runner.run_device(cfg, eng)  # compile + warm up

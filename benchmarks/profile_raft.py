@@ -117,10 +117,11 @@ def main():
 
     if args.trace:
         import pathlib
+        trace_rounds = min(args.rounds, 64)
         tdir = pathlib.Path(__file__).parent / "traces" / \
-            f"raft{args.nodes}x{args.rounds}"
+            f"raft{args.nodes}x{trace_rounds}"
         tdir.mkdir(parents=True, exist_ok=True)
-        timed_scan(cfg, raft.raft_round, seeds, min(args.rounds, 64),
+        timed_scan(cfg, raft.raft_round, seeds, trace_rounds,
                    "traced", repeats=1, trace_dir=tdir)
         log(f"trace written to {tdir}")
 
@@ -138,8 +139,8 @@ def _cheap_delivery_round(cfg, st, r):
         one = rng.random_u32_jnp(seed, rng.STREAM_DELIVER, rr, 0, 0)
         i = jnp.arange(N, dtype=jnp.uint32)[:, None]
         j = jnp.arange(N, dtype=jnp.uint32)[None, :]
-        bit = ((one >> (i * 7 + j) % 32) & 1).astype(bool)
-        return bit | (i != j)
+        bit = ((one >> ((i * 7 + j) % jnp.uint32(32))) & 1).astype(bool)
+        return bit & (i != j)
 
     try:
         adversary.delivery = cheap

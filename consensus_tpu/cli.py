@@ -26,6 +26,7 @@ _FLAG_FIELDS = {
     "max_entries": ("max_entries", 100),
     "t_min": ("t_min", 3),
     "t_max": ("t_max", 8),
+    "max_active": ("max_active", 0),
     "drop_rate": ("drop_rate", 0.0),
     "partition_rate": ("partition_rate", 0.0),
     "churn_rate": ("churn_rate", 0.0),

@@ -86,6 +86,9 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             raise ValueError("t_max must exceed t_min")
         if self.max_active < 0:
             raise ValueError("max_active must be >= 0 (0 = dense engine)")
+        if self.max_active > self.n_nodes:
+            raise ValueError("max_active must be <= n_nodes (the active set "
+                             "is a subset of the population, SPEC §3b)")
 
     # Integer cutoffs — THE values both engines compare draws against.
     @property

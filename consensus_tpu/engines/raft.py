@@ -299,9 +299,9 @@ def raft_run(cfg: Config, **kw):
     :func:`consensus_tpu.network.runner.run`.
 
     ``cfg.max_active > 0`` selects the O(A*N) large-population engine
-    (engines/raft_sparse.py, SPEC §3b); 0 selects this dense kernel."""
+    (engines/raft_sparse.py, SPEC §3b); 0 selects this dense kernel. The
+    dispatch rule lives in :func:`consensus_tpu.network.simulator.engine_def`
+    (single source for benchmarks and the digest path alike)."""
     from ..network import runner
-    if cfg.max_active > 0:
-        from . import raft_sparse
-        return runner.run(cfg, raft_sparse.get_engine(), **kw)
-    return runner.run(cfg, get_engine(), **kw)
+    from ..network.simulator import engine_def
+    return runner.run(cfg, engine_def(cfg), **kw)

@@ -25,8 +25,8 @@ decided logs are bit-identical to the dense engine's (tested in
 tests/test_raft_sparse.py); the capped semantics are mirrored scalar-for-
 scalar in the C++ oracle (cpp/oracle.cpp RaftSim with max_active > 0).
 
-Memory at N=100k, L=128, A=8: ~110 MB per sweep instance (logs dominate)
-vs ~80 GB dense — see docs/SCALE.md for the full budget.
+Memory at N=100k, L=128, A=8: ~113 MB per sweep instance (logs dominate)
+vs ~90 GB dense — see docs/SCALE.md for the full budget.
 """
 from __future__ import annotations
 
@@ -282,8 +282,19 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
         jnp.where(proc[:, None] & fail_kj,
                   jnp.maximum(1, lead_next - 1), lead_next))
 
-    # ---- P3e commit advance: majority-th largest of each tracked row.
-    med = jnp.sort(lead_match, axis=1)[:, N - majority]        # [A]
+    # ---- P3e commit advance: majority-th largest of each tracked row,
+    # via the same fixed-depth binary search as the dense kernel (raft.py
+    # P3e) — a [A, N] jnp.sort would be ~300 comparator stages per round
+    # at N=100k; log2(L) masked count-reductions are exact and cheap.
+    lo = jnp.zeros(A, jnp.int32)
+    hi = jnp.full(A, L + 1, jnp.int32)
+    for _ in range((L + 1).bit_length()):
+        mid = (lo + hi) // 2
+        cnt = jnp.sum((lead_match >= mid[:, None]).astype(jnp.int32), axis=1)
+        ok = cnt >= majority
+        lo = jnp.where(ok, mid, lo)
+        hi = jnp.where(ok, hi, mid)
+    med = lo                                                   # [A]
     kmed = jnp.clip(med - 1, 0, L - 1)
     term_at_med = log_term[lid, kmed]
     adv = proc & (med > commit[lid]) & (med > 0) & (term_at_med == term[lid])

@@ -85,6 +85,19 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
         out = _run_oracle(cfg)
         wall = time.perf_counter() - t0
 
+    counts, rec_a, rec_b, payload = decided_payload(cfg, out)
+    return RunResult(
+        config=cfg, payload=payload, digest=serialize.digest(payload),
+        wall_s=wall,
+        node_round_steps=cfg.n_sweeps * cfg.n_nodes * executed_rounds,
+        counts=counts, rec_a=np.asarray(rec_a), rec_b=np.asarray(rec_b),
+        timing_includes_compile=timing_includes_compile)
+
+
+def decided_payload(cfg: Config, out: dict):
+    """Canonical decided-log packing for an engine's extract dict —
+    the one place the per-protocol record shapes are known. Returns
+    (counts, rec_a, rec_b, payload)."""
     if cfg.protocol == "raft":
         counts, rec_a, rec_b = _decided_raft(out)
     elif cfg.protocol == "paxos":
@@ -100,32 +113,41 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
         rec_a, rec_b = np.asarray(out["chain_r"]), np.asarray(out["chain_p"])
     else:
         counts, rec_a, rec_b = out["counts"], out["rec_a"], out["rec_b"]
-
     counts = np.asarray(counts)
-    payload = serialize.serialize_decided(cfg.protocol, counts,
-                                          np.asarray(rec_a), np.asarray(rec_b))
-    return RunResult(
-        config=cfg, payload=payload, digest=serialize.digest(payload),
-        wall_s=wall,
-        node_round_steps=cfg.n_sweeps * cfg.n_nodes * executed_rounds,
-        counts=counts, rec_a=np.asarray(rec_a), rec_b=np.asarray(rec_b),
-        timing_includes_compile=timing_includes_compile)
+    rec_a, rec_b = np.asarray(rec_a), np.asarray(rec_b)
+    payload = serialize.serialize_decided(cfg.protocol, counts, rec_a, rec_b)
+    return counts, rec_a, rec_b, payload
+
+
+def engine_def(cfg: Config):
+    """The TPU EngineDef a config resolves to (raft honors the SPEC §3b
+    ``max_active`` dispatch). Benchmarks use this with
+    :func:`consensus_tpu.network.runner.run_device` to time the round
+    loop without pulling the full final state through the tunnel."""
+    if cfg.protocol == "raft":
+        if cfg.max_active > 0:
+            from ..engines import raft_sparse
+            return raft_sparse.get_engine()
+        from ..engines import raft
+        return raft.get_engine()
+    if cfg.protocol == "paxos":
+        from ..engines import paxos
+        return paxos.get_engine()
+    if cfg.protocol == "pbft":
+        from ..engines import pbft
+        return pbft.get_engine()
+    if cfg.protocol == "dpos":
+        from ..engines import dpos
+        return dpos.get_engine()
+    raise NotImplementedError(cfg.protocol)
 
 
 def _run_jax(cfg: Config, **engine_kw):
-    if cfg.protocol == "raft":
-        from ..engines.raft import raft_run
-        return raft_run(cfg, **engine_kw)
-    if cfg.protocol == "paxos":
-        from ..engines.paxos import paxos_run
-        return paxos_run(cfg, **engine_kw)
-    if cfg.protocol == "pbft":
-        from ..engines.pbft import pbft_run
-        return pbft_run(cfg, **engine_kw)
-    if cfg.protocol == "dpos":
-        from ..engines.dpos import dpos_run
-        return dpos_run(cfg, **engine_kw)
-    raise NotImplementedError(cfg.protocol)
+    # One dispatch table (engine_def) serves both the timed benchmark
+    # path (runner.run_device) and this digest path, so a timed kernel
+    # is always the kernel whose digest validates it.
+    from . import runner
+    return runner.run(cfg, engine_def(cfg), **engine_kw)
 
 
 def _run_oracle(cfg: Config):

@@ -38,6 +38,7 @@ struct Args {
   uint64_t seed = 0;
   uint32_t log_capacity = 128, max_entries = 100;
   uint32_t t_min = 3, t_max = 8;
+  uint32_t max_active = 0;  // raft: 0 = dense, >0 = SPEC §3b active cap
   double drop_rate = 0.0, partition_rate = 0.0, churn_rate = 0.0;
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
   std::string byz_mode = "silent";
@@ -63,6 +64,7 @@ uint32_t prob_threshold_u32(double p) {
       "usage: %s [--protocol raft|pbft|paxos|dpos] [--engine cpu|tpu]\n"
       "  [--nodes N] [--rounds R] [--sweeps B] [--seed S]\n"
       "  [--log-capacity L] [--max-entries E] [--t-min T] [--t-max T]\n"
+      "  [--max-active A]   (raft: 0 = dense, >0 = SPEC 3b active cap)\n"
       "  [--drop-rate P] [--partition-rate P] [--churn-rate P]\n"
       "  [--f F] [--view-timeout T] [--n-byzantine K]\n"
       "  [--byz-mode silent|equivocate] [--n-proposers P]\n"
@@ -92,6 +94,7 @@ Args parse(int argc, char** argv) {
     else if (k == "--max-entries") a.max_entries = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--t-min") a.t_min = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--t-max") a.t_max = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--max-active") a.max_active = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--drop-rate") a.drop_rate = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--partition-rate") a.partition_rate = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--churn-rate") a.churn_rate = std::strtod(need(k.c_str()), nullptr);
@@ -162,6 +165,7 @@ int run_cpu(const Args& a) {
   cfg.max_entries = a.max_entries;
   cfg.t_min = a.t_min;
   cfg.t_max = a.t_max;
+  cfg.max_active = a.max_active;
   cfg.drop_cut = prob_threshold_u32(a.drop_rate);
   cfg.part_cut = prob_threshold_u32(a.partition_rate);
   cfg.churn_cut = prob_threshold_u32(a.churn_rate);

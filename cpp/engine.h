@@ -25,6 +25,7 @@ struct SimConfig {
   uint32_t log_capacity = 128;  // raft log length / pbft+paxos slots / dpos chain
   uint32_t max_entries = 100;
   uint32_t t_min = 3, t_max = 8;
+  uint32_t max_active = 0;  // raft: 0 = dense, >0 = SPEC §3b active cap
   uint32_t drop_cut = 0, part_cut = 0, churn_cut = 0;  // u32 cutoffs
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;   // pbft
   uint32_t byz_equivocate = 0;  // pbft byz_mode == "equivocate" (SPEC §6)

@@ -73,12 +73,16 @@ struct RaftSim {
   uint64_t seed;
   uint32_t N, R, L, E, t_min, t_max;
   uint32_t drop_cut, part_cut, churn_cut;
+  uint32_t A = 0;  // max_active: 0 = dense (SPEC §3), >0 = capped (SPEC §3b)
 
   // State, struct-of-arrays to mirror the array schema (SURVEY.md §7).
   std::vector<uint32_t> term, role, log_len, commit, timer, timeout;
   std::vector<int32_t> voted_for;
   std::vector<uint32_t> log_term, log_val;        // [N*L]
-  std::vector<uint32_t> match_idx, next_idx;      // [N*N]
+  std::vector<uint32_t> match_idx, next_idx;      // [N*N] (dense only)
+  // Tracked-leader slots (capped engine only — SPEC §3b).
+  std::vector<int32_t> lead_id;                   // [A]
+  std::vector<uint32_t> lead_match, lead_next;    // [A*N]
   Net net;
 
   uint32_t& lt(uint32_t i, uint32_t k) { return log_term[i * L + k]; }
@@ -103,8 +107,29 @@ struct RaftSim {
     commit.assign(N, 0); timer.assign(N, 0); voted_for.assign(N, NONE);
     timeout.resize(N);
     log_term.assign(size_t(N) * L, 0); log_val.assign(size_t(N) * L, 0);
-    match_idx.assign(size_t(N) * N, 0); next_idx.assign(size_t(N) * N, 1);
+    if (A == 0) {
+      match_idx.assign(size_t(N) * N, 0); next_idx.assign(size_t(N) * N, 1);
+    } else {
+      lead_id.assign(A, NONE);
+      lead_match.assign(size_t(A) * N, 0);
+      lead_next.assign(size_t(A) * N, 1);
+    }
     for (uint32_t i = 0; i < N; ++i) timeout[i] = draw_timeout(0, i);
+  }
+
+  // SPEC §3b active set: ids of the top-A ``mask`` nodes by
+  // (term desc, id asc), NONE-padded to length A.
+  std::vector<int32_t> top_active(const std::vector<uint8_t>& mask) const {
+    std::vector<int32_t> ids;
+    for (uint32_t i = 0; i < N; ++i)
+      if (mask[i]) ids.push_back(int32_t(i));
+    std::sort(ids.begin(), ids.end(), [&](int32_t a, int32_t b) {
+      if (term[a] != term[b]) return term[a] > term[b];
+      return a < b;
+    });
+    ids.resize(std::min<size_t>(ids.size(), A));
+    ids.resize(A, NONE);
+    return ids;
   }
 
   void round(uint32_t r) {
@@ -266,9 +291,218 @@ struct RaftSim {
     }
   }
 
+  // One SPEC §3b round: identical phase structure to `round`, but only
+  // the top-A candidates / top-A tracked leaders send, and replication
+  // bookkeeping lives in A tracked [A, N] rows instead of [N, N].
+  // Scalar twin of engines/raft_sparse.py (decided logs bit-equal to the
+  // dense semantics whenever concurrent sender counts stay <= A).
+  void round_capped(uint32_t r) {
+    const uint32_t majority = N / 2 + 1;
+    net.begin_round(seed, N, r, drop_cut, part_cut);
+    std::vector<uint8_t> reset(N, 0);
+
+    // ---- P0 churn.
+    if (churn_fires(seed, r, churn_cut))
+      for (uint32_t i = 0; i < N; ++i)
+        if (role[i] == ROLE_L) { role[i] = ROLE_F; timer[i] = 0; reset[i] = 1; }
+
+    // ---- P1 candidacy.
+    for (uint32_t i = 0; i < N; ++i)
+      if (role[i] != ROLE_L && timer[i] >= timeout[i]) {
+        term[i] += 1;
+        role[i] = ROLE_C;
+        voted_for[i] = int32_t(i);
+        timer[i] = 0; reset[i] = 1;
+        timeout[i] = draw_timeout(term[i], i);
+      }
+
+    // ---- P2 election over the active candidate set.
+    std::vector<uint8_t> is_cand(N);
+    for (uint32_t i = 0; i < N; ++i) is_cand[i] = role[i] == ROLE_C;
+    const std::vector<int32_t> cand_ids = top_active(is_cand);
+    std::vector<uint8_t> active_cand(N, 0);
+    for (int32_t c : cand_ids)
+      if (c >= 0) active_cand[c] = 1;
+    std::vector<uint32_t> req_term(N, 0), req_lidx(N, 0), req_lterm(N, 0);
+    for (uint32_t c = 0; c < N; ++c)
+      if (active_cand[c]) {
+        req_term[c] = term[c];
+        req_lidx[c] = log_len[c];
+        req_lterm[c] = log_len[c] ? lt(c, log_len[c] - 1) : 0;
+      }
+    // P2a: term catch-up from delivered active requests.
+    for (uint32_t j = 0; j < N; ++j) {
+      uint32_t T = term[j];
+      for (uint32_t c = 0; c < N; ++c)
+        if (active_cand[c] && net.delivered(c, j)) T = std::max(T, req_term[c]);
+      if (T > term[j]) bump_term(j, T);
+    }
+    // P2b: grants (eligibility restricted to active candidates).
+    std::vector<int32_t> grant(N, NONE);
+    for (uint32_t j = 0; j < N; ++j) {
+      uint32_t own_lterm = log_len[j] ? lt(j, log_len[j] - 1) : 0;
+      int32_t g = NONE;
+      auto eligible = [&](uint32_t c) {
+        if (!active_cand[c] || c == j || !net.delivered(c, j)) return false;
+        if (req_term[c] != term[j]) return false;
+        return req_lterm[c] > own_lterm ||
+               (req_lterm[c] == own_lterm && req_lidx[c] >= log_len[j]);
+      };
+      if (voted_for[j] != NONE) {
+        if (eligible(uint32_t(voted_for[j]))) g = voted_for[j];  // re-grant
+      } else {
+        for (uint32_t c = 0; c < N; ++c)
+          if (eligible(c)) { g = int32_t(c); break; }  // lowest id
+      }
+      if (g != NONE) { voted_for[j] = g; timer[j] = 0; reset[j] = 1; }
+      grant[j] = g;
+    }
+    // P2c: tally per active candidate; winners become leaders (tracked
+    // rows are assigned by the slot lifecycle below, not here).
+    for (int32_t ci : cand_ids) {
+      if (ci < 0) continue;
+      uint32_t c = uint32_t(ci);
+      if (role[c] != ROLE_C) continue;  // may have been bumped in P2a
+      uint32_t votes = 1;  // self
+      for (uint32_t j = 0; j < N; ++j)
+        if (j != c && grant[j] == int32_t(c) && net.delivered(j, c)) ++votes;
+      if (votes >= majority) { role[c] = ROLE_L; timer[c] = 0; reset[c] = 1; }
+    }
+
+    // ---- Tracked-leader slot lifecycle: rows follow ids; entries and
+    // re-entries get fresh election-time rows (match 0 except self,
+    // next = log_len + 1 — log_len BEFORE this round's P3a append).
+    std::vector<uint8_t> is_lead(N);
+    for (uint32_t i = 0; i < N; ++i) is_lead[i] = role[i] == ROLE_L;
+    const std::vector<int32_t> new_ids = top_active(is_lead);
+    std::vector<uint32_t> nmatch(size_t(A) * N, 0), nnext(size_t(A) * N, 1);
+    for (uint32_t k = 0; k < A; ++k) {
+      const int32_t id = new_ids[k];
+      if (id < 0) continue;
+      int32_t src = NONE;
+      for (uint32_t s = 0; s < A; ++s)
+        if (lead_id[s] == id) { src = int32_t(s); break; }
+      if (src >= 0) {
+        std::copy_n(lead_match.begin() + size_t(src) * N, N,
+                    nmatch.begin() + size_t(k) * N);
+        std::copy_n(lead_next.begin() + size_t(src) * N, N,
+                    nnext.begin() + size_t(k) * N);
+      } else {
+        nmatch[size_t(k) * N + id] = log_len[id];
+        std::fill_n(nnext.begin() + size_t(k) * N, N, log_len[id] + 1);
+      }
+    }
+    lead_match.swap(nmatch);
+    lead_next.swap(nnext);
+    lead_id = new_ids;
+
+    // ---- P3a propose: every leader appends locally (tracked or not);
+    // tracked leaders' self-match follows their own append.
+    for (uint32_t l = 0; l < N; ++l)
+      if (role[l] == ROLE_L && log_len[l] < E && log_len[l] < L) {
+        lt(l, log_len[l]) = term[l];
+        lv(l, log_len[l]) = random_u32(seed, STREAM_VALUE, r, 0, l);
+        log_len[l] += 1;
+      }
+    for (uint32_t k = 0; k < A; ++k)
+      if (lead_id[k] >= 0 && role[lead_id[k]] == ROLE_L)
+        lead_match[size_t(k) * N + lead_id[k]] = log_len[lead_id[k]];
+
+    // ---- P3b snapshot tracked-sender state (post-(a), commit pre-(e)).
+    std::vector<uint8_t> was_lead_k(A, 0);
+    std::vector<uint32_t> s_term(A, 0), s_len(A, 0), s_commit(A, 0);
+    const std::vector<uint32_t> s_next = lead_next;
+    const std::vector<uint32_t> s_logt = log_term, s_logv = log_val;
+    for (uint32_t k = 0; k < A; ++k) {
+      if (lead_id[k] < 0) continue;
+      const uint32_t l = uint32_t(lead_id[k]);
+      was_lead_k[k] = role[l] == ROLE_L;
+      s_term[k] = term[l]; s_len[k] = log_len[l]; s_commit[k] = commit[l];
+    }
+
+    // ---- P3c receivers (senders = tracked leading slots only).
+    std::vector<int32_t> ack_slot(N, NONE);
+    std::vector<uint8_t> ack_ok(N, 0);
+    std::vector<uint32_t> ack_match(N, 0), ack_term(N, 0);
+    for (uint32_t j = 0; j < N; ++j) {
+      uint32_t T = term[j];
+      for (uint32_t k = 0; k < A; ++k)
+        if (was_lead_k[k] && net.delivered(uint32_t(lead_id[k]), j))
+          T = std::max(T, s_term[k]);
+      if (T > term[j]) bump_term(j, T);
+      int32_t kstar = NONE;
+      uint32_t lstar = N;
+      for (uint32_t k = 0; k < A; ++k) {
+        if (!was_lead_k[k]) continue;
+        const uint32_t l = uint32_t(lead_id[k]);
+        if (l == j || !net.delivered(l, j) || s_term[k] != term[j]) continue;
+        if (l < lstar) { lstar = l; kstar = int32_t(k); }  // lowest node id
+      }
+      if (kstar == NONE) continue;
+      const uint32_t k = uint32_t(kstar), l = lstar;
+      timer[j] = 0; reset[j] = 1;
+      if (role[j] == ROLE_C) role[j] = ROLE_F;
+      const uint32_t prev = s_next[size_t(k) * N + j] - 1;
+      const uint32_t prev_term = prev ? s_logt[size_t(l) * L + prev - 1] : 0;
+      const bool ok = prev == 0 ||
+                      (prev <= log_len[j] && lt(j, prev - 1) == prev_term);
+      ack_slot[j] = kstar;
+      ack_term[j] = term[j];
+      if (ok) {
+        for (uint32_t x = prev; x < s_len[k]; ++x) {
+          lt(j, x) = s_logt[size_t(l) * L + x];
+          lv(j, x) = s_logv[size_t(l) * L + x];
+        }
+        log_len[j] = s_len[k];
+        commit[j] = std::max(commit[j], std::min(s_commit[k], log_len[j]));
+        ack_ok[j] = 1;
+        ack_match[j] = s_len[k];
+      }
+    }
+
+    // ---- P3d tracked leaders process acks; P3e commit advance.
+    for (uint32_t k = 0; k < A; ++k) {
+      if (!was_lead_k[k]) continue;
+      const uint32_t l = uint32_t(lead_id[k]);
+      if (role[l] != ROLE_L) continue;
+      uint32_t T = term[l];
+      for (uint32_t j = 0; j < N; ++j)
+        if (ack_slot[j] == int32_t(k) && net.delivered(j, l))
+          T = std::max(T, ack_term[j]);
+      if (T > term[l]) { bump_term(l, T); continue; }
+      for (uint32_t j = 0; j < N; ++j) {
+        if (ack_slot[j] != int32_t(k) || !net.delivered(j, l)) continue;
+        uint32_t& m = lead_match[size_t(k) * N + j];
+        uint32_t& nx = lead_next[size_t(k) * N + j];
+        if (ack_ok[j]) {
+          m = std::max(m, ack_match[j]);
+          nx = m + 1;
+        } else {
+          nx = std::max(1u, nx - 1);
+        }
+      }
+      std::vector<uint32_t> m(lead_match.begin() + size_t(k) * N,
+                              lead_match.begin() + size_t(k) * N + N);
+      std::nth_element(m.begin(), m.begin() + (majority - 1), m.end(),
+                       std::greater<uint32_t>());
+      const uint32_t med = m[majority - 1];
+      if (med > commit[l] && med > 0 && lt(l, med - 1) == term[l])
+        commit[l] = med;
+    }
+
+    // ---- P4 timers.
+    for (uint32_t i = 0; i < N; ++i) {
+      if (role[i] == ROLE_L) timer[i] = 0;
+      else if (!reset[i]) timer[i] += 1;
+    }
+  }
+
   void run() {
     init();
-    for (uint32_t r = 0; r < R; ++r) round(r);
+    if (A == 0)
+      for (uint32_t r = 0; r < R; ++r) round(r);
+    else
+      for (uint32_t r = 0; r < R; ++r) round_capped(r);
   }
 };
 
@@ -650,12 +884,14 @@ class RaftEngine final : public Engine {
  public:
   const char* name() const override { return "raft"; }
   int run(const SimConfig& c) override {
-    if (c.n_nodes == 0 || c.t_max <= c.t_min) return 1;
+    if (c.n_nodes == 0 || c.t_max <= c.t_min || c.max_active > c.n_nodes)
+      return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.L = c.log_capacity; sim_.E = c.max_entries;
     sim_.t_min = c.t_min; sim_.t_max = c.t_max;
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
+    sim_.A = c.max_active;
     sim_.run();
     return 0;
   }
@@ -800,16 +1036,18 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t log_capacity, uint32_t max_entries,
                   uint32_t t_min, uint32_t t_max,
                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
+                  uint32_t max_active,     // 0 = dense; >0 = SPEC §3b cap
                   uint32_t* out_commit,    // [N]
                   uint32_t* out_log_term,  // [N*L]
                   uint32_t* out_log_val,   // [N*L]
                   uint32_t* out_term,      // [N]
                   uint32_t* out_role) {    // [N]
-  if (n_nodes == 0 || t_max <= t_min) return 1;
+  if (n_nodes == 0 || t_max <= t_min || max_active > n_nodes) return 1;
   ctpu::RaftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
   sim.E = max_entries; sim.t_min = t_min; sim.t_max = t_max;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.A = max_active;
   sim.run();
   std::memcpy(out_commit, sim.commit.data(), sizeof(uint32_t) * n_nodes);
   std::memcpy(out_log_term, sim.log_term.data(),
