@@ -50,6 +50,13 @@ CONFIGS = {
     # its own program; report the aggregate. Full 1..128 sweep is hours of
     # compiles — benchmark the power-of-two ladder.
     # (handled specially below)
+    # 3b. PBFT at the north-star population (BASELINE.json:5 "100k-node
+    # Raft+PBFT sweeps"): the SPEC §6b broadcast-atomic fault model —
+    # O(N·S·log N) tallies; the §6 dense [N,N,S] tensors cannot exist at
+    # this N. N = 3f+1.
+    "pbft-100k-bcast": Config(protocol="pbft", fault_model="bcast",
+                              f=33_333, n_nodes=100_000, n_rounds=64,
+                              n_sweeps=8, log_capacity=16, seed=7, **ADV),
     # 4. Multi-decree Paxos 10k acceptors x 10k slots.
     "paxos-10kx10k": Config(protocol="paxos", n_nodes=10_000, n_rounds=16,
                             n_sweeps=1, log_capacity=10_000, seed=4, **ADV),
@@ -69,6 +76,9 @@ ORACLE_SIZED = {
                                       n_rounds=32),
     "raft-100k": dataclasses.replace(CONFIGS["raft-100k"], n_nodes=2048,
                                      n_sweeps=1, n_rounds=32),
+    "pbft-100k-bcast": dataclasses.replace(CONFIGS["pbft-100k-bcast"],
+                                           f=500, n_nodes=1501, n_sweeps=1,
+                                           n_rounds=16),
     "paxos-10kx10k": dataclasses.replace(CONFIGS["paxos-10kx10k"],
                                          n_nodes=1000, log_capacity=1000,
                                          n_rounds=8),

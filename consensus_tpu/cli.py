@@ -34,6 +34,7 @@ _FLAG_FIELDS = {
     "view_timeout": ("view_timeout", 8),
     "n_byzantine": ("n_byzantine", 0),
     "byz_mode": ("byz_mode", "silent"),
+    "fault_model": ("fault_model", "edge"),
     "n_proposers": ("n_proposers", 0),
     "candidates": ("n_candidates", 16),
     "producers": ("n_producers", 4),
@@ -41,8 +42,8 @@ _FLAG_FIELDS = {
     "scan_chunk": ("scan_chunk", 0),
 }
 _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
-               "drop_rate": float, "partition_rate": float,
-               "churn_rate": float}
+               "fault_model": str, "drop_rate": float,
+               "partition_rate": float, "churn_rate": float}
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -54,6 +54,12 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     n_byzantine: int = 0         # byzantine nodes (ids >= N - n_byzantine)
     byz_mode: str = "silent"     # "silent" | "equivocate" (SPEC §6)
 
+    # Fault granularity (SPEC §6b). "edge" = per directed edge (§2,
+    # exact, O(N²) tallies); "bcast" = per-sender broadcast drops — the
+    # large-N PBFT model (pbft only; rejected elsewhere, no silent
+    # ignores).
+    fault_model: str = "edge"
+
     # Paxos.
     n_proposers: int = 0         # 0 ⇒ all nodes propose
 
@@ -82,6 +88,12 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
                 raise ValueError("n_byzantine must be <= f")
         if self.byz_mode not in ("silent", "equivocate"):
             raise ValueError(f"unknown byz_mode {self.byz_mode!r}")
+        if self.fault_model not in ("edge", "bcast"):
+            raise ValueError(f"unknown fault_model {self.fault_model!r}")
+        if self.fault_model == "bcast" and self.protocol != "pbft":
+            raise ValueError(
+                "fault_model='bcast' (SPEC §6b) is a pbft model; other "
+                "protocols would silently ignore it")
         if self.t_max <= self.t_min:
             raise ValueError("t_max must exceed t_min")
         if self.max_active < 0:

@@ -192,5 +192,9 @@ def get_engine():
 
 
 def pbft_run(cfg: Config, **kw):
+    """``cfg.fault_model == "bcast"`` selects the SPEC §6b large-N engine
+    (engines/pbft_bcast.py); the dispatch rule lives in
+    :func:`consensus_tpu.network.simulator.engine_def`."""
     from ..network import runner
-    return runner.run(cfg, get_engine(), **kw)
+    from ..network.simulator import engine_def
+    return runner.run(cfg, engine_def(cfg), **kw)

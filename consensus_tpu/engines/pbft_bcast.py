@@ -1,0 +1,260 @@
+"""PBFT under the broadcast-atomic fault model (docs/SPEC.md §6b) —
+the large-N engine.
+
+The §6 dense kernel (engines/pbft.py) compares values pairwise:
+`[i, j, s]` tensors, O(N²·S) — structurally impossible at the north
+star's 100k-node scale (BASELINE.json:5 names PBFT in the 100k sweeps).
+Under §6b, faults drop a sender's round broadcast atomically, so a
+receiver's prepare/commit tally is a pure multiset count over the slot's
+sender values, computable in O(N·S·log N):
+
+  * one `lax.sort` per slot over the sender values, carrying the two
+    per-partition-side validity flags as payload;
+  * inclusive→exclusive cumulative sums of each flag over the sorted
+    order (partitions are side-separable, §2);
+  * per receiver, `searchsorted` left/right brackets its own value's
+    run; the cumsum difference of its side's flag is the exact count —
+    no sentinel values, so arbitrary 32-bit payloads are safe.
+
+Protocol phases, state, and tie-breaks are §6's verbatim; only fault
+granularity changes (SPEC §6b: per-sender drops, unchanged partitions,
+per-round equivocation stances). With drop_rate = partition_rate = 0 and
+no byzantine nodes this engine is round-for-round identical to the dense
+one (tested in tests/test_pbft_bcast.py, along with differential
+byte-equivalence vs the oracle's §6b path — cpp/oracle.cpp PbftSim with
+fault_bcast = 1, the BcastNet/del/eq_sup dispatch in PbftSim::run).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.config import Config
+from ..ops.adversary import draw as _draw
+from ..ops.adversary import cutoff as _lt
+from ..ops.adversary import bitcast_i32 as _i32
+from .pbft import PbftState, pbft_init
+
+I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class _SortedCounter:
+    """Exact multiset counter: count_b[s, j] = |{i : valid_b[s, i] ∧
+    vals[s, i] == query[s, j]}| for arbitrary i32 values (validity rides
+    a permutation; nothing is masked to a sentinel).
+
+    The O(N·S·log N) sort and both searchsorted brackets depend only on
+    (vals, query), so they run ONCE per round and serve both the P4 and
+    P5 tallies — only the per-phase validity gather/cumsum differs.
+    """
+
+    def __init__(self, vals_sn, query_sn):
+        S, N = vals_sn.shape
+        iota = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (S, N))
+        self.sv, self.perm = jax.lax.sort((vals_sn, iota), dimension=1,
+                                          num_keys=1)
+
+        def one_slot(sorted_v, q):
+            return (jnp.searchsorted(sorted_v, q, side="left"),
+                    jnp.searchsorted(sorted_v, q, side="right"))
+
+        self.lo, self.hi = jax.vmap(one_slot)(self.sv, query_sn)
+
+    def count(self, valid_sn):
+        f = jnp.take_along_axis(valid_sn.astype(jnp.int32), self.perm, axis=1)
+        zero = jnp.zeros(f.shape[:-1] + (1,), jnp.int32)
+        ex = jnp.concatenate([zero, jnp.cumsum(f, axis=1)], axis=1)  # [S,N+1]
+        return (jnp.take_along_axis(ex, self.hi, axis=1)
+                - jnp.take_along_axis(ex, self.lo, axis=1))
+
+
+def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
+    N, S = cfg.n_nodes, cfg.log_capacity
+    f = cfg.f
+    Q = 2 * f + 1
+    K = f + 1
+    seed = st.seed
+    ur = jnp.asarray(r, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    uidx = idx.astype(jnp.uint32)
+    sarange = jnp.arange(S, dtype=jnp.int32)
+
+    # ---- SPEC §6b adversary: per-sender broadcast drops + §2 partition.
+    bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
+    part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
+                   < _lt(cfg.partition_cutoff))
+    side = (_draw(seed, rng.STREAM_PARTITION, ur, 1, uidx)
+            & jnp.uint32(1)).astype(jnp.int32)                   # [N]
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+    honest = idx < (N - cfg.n_byzantine)
+    byz = ~honest
+
+    def side_ok(b):
+        return ~part_active | (side == b)
+
+    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
+    if equiv:
+        stance = (_draw(seed, rng.STREAM_EQUIV, ur, uidx,
+                        jnp.uint32(0x80000000)) & jnp.uint32(1)).astype(bool)
+
+    view, timer = st.view, st.timer
+    pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
+    prepared, committed, dval = st.prepared, st.committed, st.dval
+    committed_at_start = committed
+
+    # ---- P0 churn.
+    view = view + churn.astype(jnp.int32)
+    timer = jnp.where(churn, 0, timer)
+    reset = jnp.broadcast_to(churn, (N,))
+
+    # ---- P1 view catch-up: (f+1)-th largest of delivered honest views
+    # ∪ own. Senders are side-separable; per side b take the K-th and
+    # (K-1)-th largest sender views (ascending sort, -1 pads — views are
+    # always >= 0), then the receiver-side insertion is a clamp:
+    # inserting own view x into a desc-sorted multiset T makes the K-th
+    # largest clip(x, T[K-1], T[K-2]); a receiver that IS a sender
+    # replaces its own copy, leaving the multiset unchanged.
+    sender_v = honest & bcast
+    a1 = []
+    a2 = []
+    for b in (0, 1):
+        col = jnp.where(sender_v & side_ok(b), view, -1)
+        t = jnp.sort(col)                                        # ascending
+        a1.append(t[N - K])
+        a2.append(t[N - K + 1] if K >= 2 else jnp.int32(I32_MAX))
+    a1 = jnp.stack(a1)[side]                                     # [N]
+    a2 = jnp.stack(a2)[side]
+    in_set = sender_v                                            # self side ok
+    vth = jnp.where(in_set, a1, jnp.clip(view, a1, a2))
+    catch = vth > view
+    view = jnp.where(catch, vth, view)
+    timer = jnp.where(catch, 0, timer)
+    reset |= catch
+
+    # ---- P2 timeout.
+    to = timer >= cfg.view_timeout
+    view = view + to.astype(jnp.int32)
+    timer = jnp.where(to, 0, timer)
+    reset |= to
+
+    # ---- P3 pre-prepare (one sender per receiver — O(N·S) gathers).
+    is_primary = honest & (view % N == idx)
+    fresh = jnp.min(jnp.where(~pp_seen, sarange[None, :], S), axis=1)
+    fresh_hot = (sarange[None, :] == fresh[:, None])
+    ppb = is_primary[:, None] & ((pp_seen & ~committed) | fresh_hot)
+    fresh_val = _i32(_draw(seed, rng.STREAM_VALUE,
+                           view[:, None].astype(jnp.uint32), 2,
+                           sarange[None, :].astype(jnp.uint32)))
+    msg_val = jnp.where(pp_seen, pp_val, fresh_val)
+
+    prim = view % N
+    prim_del = (prim == idx) | (bcast[prim]
+                                & (~part_active | (side[prim] == side)))
+    prim_ok = prim_del & (view[prim] == view)
+    pm_b = ppb[prim]
+    pm_val = msg_val[prim]
+    if equiv:
+        prim_byz = byz[prim]
+        bval = _i32(_draw(seed, rng.STREAM_VALUE,
+                          view[:, None].astype(jnp.uint32),
+                          jnp.where(stance[prim], 4, 3)[:, None]
+                          .astype(jnp.uint32),
+                          sarange[None, :].astype(jnp.uint32)))
+        prim_ok = jnp.where(prim_byz, prim_del, prim_ok)
+        pm_b = pm_b | prim_byz[:, None]
+        pm_val = jnp.where(prim_byz[:, None], bval, pm_val)
+    accept = (prim_ok[:, None] & pm_b
+              & (~pp_seen | (pp_view < view[:, None]))
+              & (~prepared | (pm_val == pp_val)))
+    pp_view = jnp.where(accept, view[:, None], pp_view)
+    pp_val = jnp.where(accept, pm_val, pp_val)
+    pp_seen = pp_seen | accept
+
+    # Shared [S, N] views of the tally inputs; one sort serves P4 + P5.
+    vals_sn = pp_val.T
+    counter = _SortedCounter(vals_sn, vals_sn)
+
+    if equiv:
+        # Byz support is value-independent (SPEC §6b): one count per
+        # side, minus the receiver's own stance (self never travels).
+        eq_send = byz & bcast & stance
+        extra = jnp.stack([jnp.sum((eq_send & side_ok(0)).astype(jnp.int32)),
+                           jnp.sum((eq_send & side_ok(1)).astype(jnp.int32))
+                           ])[side]                              # [N]
+        extra = extra - (eq_send).astype(jnp.int32)
+        extra = extra[:, None]
+    else:
+        extra = jnp.zeros((N, 1), jnp.int32)
+
+    def counts_for(relevant_ns):
+        """Value-matched §6b count[j, s] incl. self (SPEC §6 P4/P5):
+        sorted-count of broadcasting senders + the self vote (which
+        never travels, so it counts regardless of bcast fate)."""
+        c0 = counter.count((honest & bcast & side_ok(0))[None, :]
+                           & relevant_ns.T)
+        c1 = counter.count((honest & bcast & side_ok(1))[None, :]
+                           & relevant_ns.T)
+        cnt = jnp.where((side == 0)[None, :], c0, c1).T           # [N, S]
+        self_adj = (honest[:, None] & relevant_ns
+                    & ~bcast[:, None]).astype(jnp.int32)
+        return cnt + self_adj + extra
+
+    # ---- P4 prepare tally.
+    pcount = counts_for(pp_seen)
+    prepared = prepared | (pp_seen & (pcount >= Q))
+
+    # ---- P5 commit tally.
+    ccount = counts_for(prepared)
+    commit_now = prepared & (ccount >= Q) & ~committed
+    dval = jnp.where(commit_now, pp_val, dval)
+    committed = committed | commit_now
+
+    # ---- P6 decide gossip: lowest-id broadcasting decider per side.
+    dec = honest[:, None] & bcast[:, None] & committed            # [N, S]
+    imin = []
+    for b in (0, 1):
+        src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
+        imin.append(jnp.min(src, axis=0))                         # [S]
+    imin = jnp.stack(imin)[side]                                  # [N, S]
+    adopt = (imin < N) & ~committed
+    dval = jnp.where(adopt, dval[jnp.clip(imin, 0, N - 1),
+                                 sarange[None, :]], dval)
+    committed = committed | adopt
+
+    # ---- P7 timer.
+    new_commit = jnp.any(committed & ~committed_at_start, axis=1)
+    timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
+                      timer + 1)
+
+    return PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
+                     prepared, committed, dval)
+
+
+def _extract(st: PbftState) -> dict:
+    return {"committed": st.committed, "dval": st.dval, "view": st.view,
+            "prepared": st.prepared, "pp_val": st.pp_val,
+            "pp_seen": st.pp_seen}
+
+
+def _pspec(cfg: Config) -> PbftState:
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import NODE_AXIS as ND
+    v, m = P(ND), P(ND, None)
+    return PbftState(seed=P(), view=v, timer=v, pp_seen=m, pp_view=m,
+                     pp_val=m, prepared=m, committed=m, dval=m)
+
+
+_ENGINE = None
+
+
+def get_engine():
+    global _ENGINE
+    if _ENGINE is None:
+        from ..network.runner import EngineDef
+        _ENGINE = EngineDef("pbft-bcast", pbft_init, pbft_bcast_round,
+                            _extract, _pspec)
+    return _ENGINE

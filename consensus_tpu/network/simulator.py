@@ -134,6 +134,9 @@ def engine_def(cfg: Config):
         from ..engines import paxos
         return paxos.get_engine()
     if cfg.protocol == "pbft":
+        if cfg.fault_model == "bcast":
+            from ..engines import pbft_bcast
+            return pbft_bcast.get_engine()
         from ..engines import pbft
         return pbft.get_engine()
     if cfg.protocol == "dpos":

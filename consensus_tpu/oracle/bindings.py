@@ -45,7 +45,7 @@ def get_lib() -> ctypes.CDLL:
     lib.ctpu_paxos_run.restype = ctypes.c_int
     lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 7 + [p32, p8, p32, p32, p32]
     lib.ctpu_pbft_run.restype = ctypes.c_int
-    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 10 + [p8, p32, p32]
+    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 11 + [p8, p32, p32]
     lib.ctpu_dpos_run.restype = ctypes.c_int
     lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 9 + [p32] * 3
     _lib = lib
@@ -120,6 +120,7 @@ def pbft_run(cfg, sweep: int = 0):
     rc = lib.ctpu_pbft_run(
         seed, N, cfg.n_rounds, S, cfg.f, cfg.view_timeout, cfg.n_byzantine,
         1 if cfg.byz_mode == "equivocate" else 0,
+        1 if cfg.fault_model == "bcast" else 0,
         cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
         out["committed"].reshape(-1), out["dval"].reshape(-1), out["view"])
     if rc != 0:

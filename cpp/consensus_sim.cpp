@@ -42,6 +42,7 @@ struct Args {
   double drop_rate = 0.0, partition_rate = 0.0, churn_rate = 0.0;
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
   std::string byz_mode = "silent";
+  std::string fault_model = "edge";  // "edge" (SPEC §2) | "bcast" (§6b, pbft)
   uint32_t n_proposers = 0;
   uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;
   std::string out_path;  // optional: dump raw payload bytes
@@ -67,7 +68,8 @@ uint32_t prob_threshold_u32(double p) {
       "  [--max-active A]   (raft: 0 = dense, >0 = SPEC 3b active cap)\n"
       "  [--drop-rate P] [--partition-rate P] [--churn-rate P]\n"
       "  [--f F] [--view-timeout T] [--n-byzantine K]\n"
-      "  [--byz-mode silent|equivocate] [--n-proposers P]\n"
+      "  [--byz-mode silent|equivocate] [--fault-model edge|bcast]\n"
+      "  [--n-proposers P]\n"
       "  [--candidates C] [--producers K] [--epoch-len E] [--out FILE]\n",
       argv0);
   std::exit(code);
@@ -102,6 +104,7 @@ Args parse(int argc, char** argv) {
     else if (k == "--view-timeout") a.view_timeout = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--n-byzantine") a.n_byzantine = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--byz-mode") a.byz_mode = need(k.c_str());
+    else if (k == "--fault-model") a.fault_model = need(k.c_str());
     else if (k == "--n-proposers") a.n_proposers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--candidates") a.n_candidates = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--producers") a.n_producers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
@@ -113,6 +116,16 @@ Args parse(int argc, char** argv) {
   if (a.protocol == "pbft" && !a.nodes_given) a.nodes = 3 * a.f + 1;
   if (a.byz_mode != "silent" && a.byz_mode != "equivocate") {
     std::fprintf(stderr, "unknown --byz-mode %s\n", a.byz_mode.c_str());
+    std::exit(2);
+  }
+  if (a.fault_model != "edge" && a.fault_model != "bcast") {
+    std::fprintf(stderr, "unknown --fault-model %s\n", a.fault_model.c_str());
+    std::exit(2);
+  }
+  if (a.fault_model == "bcast" && a.protocol != "pbft") {
+    std::fprintf(stderr,
+                 "--fault-model bcast (SPEC 6b) is a pbft model; %s would "
+                 "silently ignore it\n", a.protocol.c_str());
     std::exit(2);
   }
   return a;
@@ -173,6 +186,7 @@ int run_cpu(const Args& a) {
   cfg.view_timeout = a.view_timeout;
   cfg.n_byzantine = a.n_byzantine;
   cfg.byz_equivocate = a.byz_mode == "equivocate" ? 1 : 0;
+  cfg.fault_bcast = a.fault_model == "bcast" ? 1 : 0;
   cfg.n_proposers = a.n_proposers;
   cfg.n_candidates = a.n_candidates;
   cfg.n_producers = a.n_producers;

@@ -29,6 +29,7 @@ struct SimConfig {
   uint32_t drop_cut = 0, part_cut = 0, churn_cut = 0;  // u32 cutoffs
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;   // pbft
   uint32_t byz_equivocate = 0;  // pbft byz_mode == "equivocate" (SPEC §6)
+  uint32_t fault_bcast = 0;     // pbft fault_model == "bcast" (SPEC §6b)
   uint32_t n_proposers = 0;                            // paxos
   uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;  // dpos
 };

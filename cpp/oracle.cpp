@@ -513,7 +513,8 @@ struct RaftSim {
 struct PbftSim {
   uint64_t seed;
   uint32_t N, R, S, f, view_timeout, n_byz;
-  uint32_t equiv = 0;  // byz_mode == "equivocate" (SPEC §6)
+  uint32_t equiv = 0;        // byz_mode == "equivocate" (SPEC §6)
+  uint32_t fault_bcast = 0;  // SPEC §6b broadcast-atomic fault model
   uint32_t drop_cut, part_cut, churn_cut;
 
   std::vector<uint32_t> view, timer;                    // [N]
@@ -526,6 +527,49 @@ struct PbftSim {
   // Byz i's per-receiver stance in round r (SPEC §6 equivocate mode).
   bool sup(uint32_t r, uint32_t i, uint32_t j) const {
     return random_u32(seed, STREAM_EQUIV, r, i, j) & 1u;
+  }
+
+  // --- SPEC §6b (fault_bcast): broadcast-atomic delivery -------------
+  // Scalar twin of engines/pbft_bcast.py, implemented straight from the
+  // §6b definition (per-receiver multisets), NOT via the engine's
+  // sorted-count formulation — so the differential tests cross-check
+  // two independent derivations.
+  struct BcastNet {
+    uint64_t seed;
+    uint32_t r = 0;
+    bool part_active = false;
+    std::vector<uint8_t> bcast, side;  // [N]
+
+    void begin_round(uint64_t seed_, uint32_t n, uint32_t r_,
+                     uint32_t drop_cut, uint32_t part_cut) {
+      seed = seed_;
+      r = r_;
+      bcast.resize(n);
+      side.assign(n, 0);
+      part_active = random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
+      for (uint32_t i = 0; i < n; ++i) {
+        bcast[i] = delivery_u32(seed, r, i, i) >= drop_cut;
+        if (part_active)
+          side[i] = random_u32(seed, STREAM_PARTITION, r, 1, i) & 1u;
+      }
+    }
+    // i's round broadcast reaches j (i != j handled by callers).
+    bool delivered(uint32_t i, uint32_t j) const {
+      return bcast[i] && (!part_active || side[i] == side[j]);
+    }
+  };
+  BcastNet bnet;
+
+  // Byz i's per-ROUND stance (SPEC §6b item 3).
+  bool stance(uint32_t r, uint32_t i) const {
+    return random_u32(seed, STREAM_EQUIV, r, i, 0x80000000u) & 1u;
+  }
+  // Fault-model-dispatched delivery + equivocation stance.
+  bool del(uint32_t /*r*/, uint32_t i, uint32_t j) const {
+    return fault_bcast ? bnet.delivered(i, j) : net.delivered(i, j);
+  }
+  bool eq_sup(uint32_t r, uint32_t i, uint32_t j) const {
+    return fault_bcast ? stance(r, i) : sup(r, i, j);
   }
 
   void run() {
@@ -546,7 +590,10 @@ struct PbftSim {
     std::vector<uint32_t> s_val, s_dval;
 
     for (uint32_t r = 0; r < R; ++r) {
-      net.begin_round(seed, N, r, drop_cut, part_cut);
+      if (fault_bcast)
+        bnet.begin_round(seed, N, r, drop_cut, part_cut);
+      else
+        net.begin_round(seed, N, r, drop_cut, part_cut);
       std::fill(reset.begin(), reset.end(), 0);
       std::fill(new_commit.begin(), new_commit.end(), 0);
 
@@ -562,7 +609,7 @@ struct PbftSim {
         views_in.clear();
         views_in.push_back(s_view[j]);
         for (uint32_t i = 0; i < N; ++i)
-          if (i != j && honest(i) && net.delivered(i, j))
+          if (i != j && honest(i) && del(r, i, j))
             views_in.push_back(s_view[i]);
         if (views_in.size() >= f + 1) {
           std::nth_element(views_in.begin(), views_in.begin() + f,
@@ -600,16 +647,16 @@ struct PbftSim {
       for (uint32_t j = 0; j < N; ++j) {
         uint32_t prim = view[j] % N;
         bool prim_byz = equiv && !honest(prim);
-        bool del = prim == j || net.delivered(prim, j);
+        bool pdel = prim == j || del(r, prim, j);
         // A byz primary lies about its view, so only delivery gates it;
         // it offers EVERY slot, per-receiver conflicting values.
-        bool ok = prim_byz ? del : (del && s_view[prim] == view[j]);
+        bool ok = prim_byz ? pdel : (pdel && s_view[prim] == view[j]);
         if (!ok) continue;
         for (uint32_t s = 0; s < S; ++s) {
           uint32_t v;
           if (prim_byz) {
             v = random_u32(seed, STREAM_VALUE, view[j],
-                           sup(r, prim, j) ? 4 : 3, s);
+                           eq_sup(r, prim, j) ? 4 : 3, s);
           } else {
             if (!s_ppb[at(prim, s)]) continue;
             v = s_msgval[at(prim, s)];
@@ -631,10 +678,10 @@ struct PbftSim {
           for (uint32_t i = 0; i < N; ++i) {
             if (honest(i) && s_seen[at(i, s)] &&
                 s_val[at(i, s)] == s_val[at(j, s)] &&
-                (i == j || net.delivered(i, j)))
+                (i == j || del(r, i, j)))
               ++cnt;
-            else if (equiv && !honest(i) && i != j && net.delivered(i, j) &&
-                     sup(r, i, j))
+            else if (equiv && !honest(i) && i != j && del(r, i, j) &&
+                     eq_sup(r, i, j))
               ++cnt;  // byz i claims j's exact value iff its stance coin
           }
           if (cnt >= Q) prepared[at(j, s)] = 1;
@@ -649,10 +696,10 @@ struct PbftSim {
           for (uint32_t i = 0; i < N; ++i) {
             if (honest(i) && s_prep[at(i, s)] &&
                 s_val[at(i, s)] == s_val[at(j, s)] &&
-                (i == j || net.delivered(i, j)))
+                (i == j || del(r, i, j)))
               ++cnt;
-            else if (equiv && !honest(i) && i != j && net.delivered(i, j) &&
-                     sup(r, i, j))
+            else if (equiv && !honest(i) && i != j && del(r, i, j) &&
+                     eq_sup(r, i, j))
               ++cnt;
           }
           if (cnt >= Q) {
@@ -668,7 +715,7 @@ struct PbftSim {
         for (uint32_t s = 0; s < S; ++s) {
           if (s_comm[at(j, s)]) continue;
           for (uint32_t i = 0; i < N; ++i)  // ascending ⇒ lowest id wins
-            if (honest(i) && s_comm[at(i, s)] && net.delivered(i, j)) {
+            if (honest(i) && s_comm[at(i, s)] && del(r, i, j)) {
               committed[at(j, s)] = 1;
               dval[at(j, s)] = s_dval[at(i, s)];
               new_commit[j] = 1;
@@ -944,6 +991,7 @@ class PbftEngine final : public SlotEngine<PbftSim> {
     sim_.S = c.log_capacity; sim_.f = c.f;
     sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
     sim_.equiv = c.byz_equivocate;
+    sim_.fault_bcast = c.fault_bcast;
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
     sim_.run();
@@ -1062,6 +1110,7 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
 int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t n_slots, uint32_t f, uint32_t view_timeout,
                   uint32_t n_byzantine, uint32_t byz_equivocate,
+                  uint32_t fault_bcast,     // SPEC §6b broadcast faults
                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
                   uint8_t* out_committed,   // [N*S]
                   uint32_t* out_dval,       // [N*S]
@@ -1071,6 +1120,7 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
   sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
   sim.equiv = byz_equivocate;
+  sim.fault_bcast = fault_bcast;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;

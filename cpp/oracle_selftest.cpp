@@ -18,8 +18,8 @@ int ctpu_raft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t*,
                   uint32_t*, uint32_t*, uint32_t*, uint32_t*);
 int ctpu_pbft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint8_t*,
-                  uint32_t*, uint32_t*);
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint8_t*, uint32_t*, uint32_t*);
 int ctpu_paxos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                    uint32_t, uint32_t, uint32_t*, uint8_t*, uint32_t*,
                    uint32_t*, uint32_t*);
@@ -80,12 +80,18 @@ int main() {
     // committed (u8, round up to words) + dval + view
     size_t W = (ns + 3) / 4 + ns + N;
     rc |= run_twice("pbft", W, [&](uint32_t* o) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, DROP, PART, CHURN,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, 0, DROP, PART, CHURN,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     rc |= run_twice("pbft-equiv", W, [&](uint32_t* o) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, DROP, PART, CHURN,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN,
+                           reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
+                           o + (ns + 3) / 4 + ns);
+    });
+    // SPEC §6b broadcast-atomic fault model, with equivocation.
+    rc |= run_twice("pbft-bcast", W, [&](uint32_t* o) {
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
