@@ -195,6 +195,7 @@ def main(argv=None) -> int:
             ("--checkpoint", args.checkpoint),
             ("--profile", args.profile),
             ("--sweeps", cfg.n_sweeps != 1),
+            ("--fault-model bcast", cfg.fault_model == "bcast"),
         ] if on]
         if unsupported:
             parser.error(f"{', '.join(unsupported)}: not supported with "

@@ -128,6 +128,42 @@ def get_engine():
     return _ENGINE
 
 
+def lib_index(chain_p, chain_len, n_candidates: int, n_producers: int):
+    """SPEC §7 last-irreversible block: largest local index k such that
+    the blocks after k were produced by >= T = floor(2K/3)+1 distinct
+    candidates (-1 if none). Computed once from final chains (forks are
+    unreachable in this model — SPEC §7 fork-choice note — so LIB is the
+    only meaningful piece of the BitShares/EOS chain rule here).
+
+    Vectorized over leading batch axes: chain_p [..., L], chain_len
+    [...] -> lib [...]. Equivalent closed form: (T-th largest of each
+    candidate's last occurrence index) - 1, clamped to -1.
+    """
+    chain_p = np.asarray(chain_p)
+    chain_len = np.asarray(chain_len)
+    T = (2 * n_producers) // 3 + 1
+    lead = chain_p.shape[:-1]
+    L = chain_p.shape[-1]
+    last_occ = np.full(lead + (n_candidates,), -1, np.int64)
+    for k in range(L):  # ascending k ⇒ later assignments win = last occ.
+        mask = k < chain_len
+        p = chain_p[..., k]
+        if lead:
+            idx = np.nonzero(mask)
+            last_occ[idx + (p[idx],)] = k
+        elif mask:
+            last_occ[p] = k
+    if T > n_candidates:
+        return np.full(lead, -1, np.int64)
+    lt = np.partition(last_occ, n_candidates - T, axis=-1)[..., n_candidates - T]
+    return np.maximum(lt - 1, -1)
+
+
 def dpos_run(cfg: Config, **kw):
+    """Returns {chain_r, chain_p, chain_len, lib} (host numpy, leading
+    sweep axis); ``lib`` is the SPEC §7 last-irreversible index."""
     from ..network import runner
-    return runner.run(cfg, get_engine(), **kw)
+    out = runner.run(cfg, get_engine(), **kw)
+    out["lib"] = lib_index(out["chain_p"], out["chain_len"],
+                           cfg.n_candidates, cfg.n_producers)
+    return out

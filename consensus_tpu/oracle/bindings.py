@@ -46,8 +46,9 @@ def get_lib() -> ctypes.CDLL:
     lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 7 + [p32, p8, p32, p32, p32]
     lib.ctpu_pbft_run.restype = ctypes.c_int
     lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 11 + [p8, p32, p32]
+    pi32 = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
     lib.ctpu_dpos_run.restype = ctypes.c_int
-    lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 9 + [p32] * 3
+    lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 9 + [p32] * 3 + [pi32]
     _lib = lib
     return lib
 
@@ -136,13 +137,14 @@ def dpos_run(cfg, sweep: int = 0):
         "chain_r": np.zeros((V, L), np.uint32),
         "chain_p": np.zeros((V, L), np.uint32),
         "chain_len": np.zeros(V, np.uint32),
+        "lib": np.zeros(V, np.int32),
     }
     seed = (cfg.seed + sweep) & 0xFFFFFFFFFFFFFFFF
     rc = lib.ctpu_dpos_run(
         seed, V, cfg.n_rounds, L, cfg.n_candidates, cfg.n_producers,
         cfg.epoch_len, cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
         out["chain_r"].reshape(-1), out["chain_p"].reshape(-1),
-        out["chain_len"])
+        out["chain_len"], out["lib"])
     if rc != 0:
         raise RuntimeError(f"oracle dpos_run failed rc={rc}")
     return out

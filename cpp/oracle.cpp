@@ -868,6 +868,25 @@ struct DposSim {
 
   std::vector<uint32_t> chain_r, chain_p;  // [V*L]
   std::vector<uint32_t> chain_len;         // [V]
+  std::vector<int32_t> lib;                // [V] SPEC §7 LIB index, -1 none
+
+  // SPEC §7 LIB: largest local index k with >= T = 2K/3+1 distinct
+  // producers among the blocks after k. Computed once from the final
+  // chains; twin of engines/dpos.py lib_index.
+  void compute_lib() {
+    lib.assign(V, -1);
+    const uint32_t T = (2 * K) / 3 + 1;
+    if (T > C) return;
+    std::vector<int32_t> last_occ(C);
+    for (uint32_t v = 0; v < V; ++v) {
+      std::fill(last_occ.begin(), last_occ.end(), -1);
+      for (uint32_t k = 0; k < chain_len[v]; ++k)
+        last_occ[chain_p[size_t(v) * L + k]] = int32_t(k);
+      std::nth_element(last_occ.begin(), last_occ.begin() + (T - 1),
+                       last_occ.end(), std::greater<int32_t>());
+      lib[v] = std::max(last_occ[T - 1] - 1, -1);
+    }
+  }
 
   void run() {
     chain_r.assign(size_t(V) * L, 0);
@@ -914,6 +933,7 @@ struct DposSim {
         }
       }
     }
+    compute_lib();
   }
 };
 
@@ -1159,7 +1179,8 @@ int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
                   uint32_t* out_chain_r,    // [V*L]
                   uint32_t* out_chain_p,    // [V*L]
-                  uint32_t* out_chain_len) {  // [V]
+                  uint32_t* out_chain_len,  // [V]
+                  int32_t* out_lib) {       // [V] SPEC §7 LIB, -1 = none
   if (n_nodes == 0 || n_candidates == 0 || n_producers == 0 ||
       n_producers > n_candidates || n_candidates > n_nodes || epoch_len == 0)
     return 1;
@@ -1172,6 +1193,7 @@ int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   std::memcpy(out_chain_r, sim.chain_r.data(), sizeof(uint32_t) * vl);
   std::memcpy(out_chain_p, sim.chain_p.data(), sizeof(uint32_t) * vl);
   std::memcpy(out_chain_len, sim.chain_len.data(), sizeof(uint32_t) * n_nodes);
+  std::memcpy(out_lib, sim.lib.data(), sizeof(int32_t) * n_nodes);
   return 0;
 }
 

@@ -25,7 +25,7 @@ int ctpu_paxos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                    uint32_t*, uint32_t*);
 int ctpu_dpos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t*, uint32_t*,
-                  uint32_t*);
+                  uint32_t*, int32_t*);
 }
 
 namespace {
@@ -109,10 +109,11 @@ int main() {
   {
     const uint32_t V = 64, R = 64, L = 64, C = 16, K = 4, EP = 16;
     size_t vl = size_t(V) * L;
-    size_t W = 2 * vl + V;
+    size_t W = 2 * vl + 2 * V;  // chains + chain_len + lib
     rc |= run_twice("dpos", W, [&](uint32_t* o) {
       return ctpu_dpos_run(33, V, R, L, C, K, EP, DROP, PART, CHURN, o, o + vl,
-                           o + 2 * vl);
+                           o + 2 * vl,
+                           reinterpret_cast<int32_t*>(o + 2 * vl + V));
     });
   }
   if (rc == 0) std::printf("selftest: ALL CLEAN\n");

@@ -121,10 +121,50 @@ def test_cli_fsweep_digest_matches_per_f_runs(capsys):
     assert sweep["steps"] == sum(3 * f + 1 for f in fs) * 24
 
 
+def test_cli_fsweep_schema_stable(capsys):
+    """The --f-sweep JSON report is machine-consumed (benchmarks, the
+    driver); its key set is a frozen schema (VERDICT r3 #6)."""
+    rc = cli.main(["--protocol", "pbft", "--rounds", "8", "--log-capacity",
+                   "8", "--engine", "tpu", "--f-sweep", "1,2"])
+    assert rc == 0
+    sweep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(sweep) == {
+        "protocol", "engine", "platform", "f_sweep", "n_elements",
+        "n_rounds", "seed", "steps", "wall_s", "steps_per_sec",
+        "compile_s_one_program", "payload_bytes", "digest"}
+    assert sweep["n_elements"] == 2 and len(sweep["digest"]) == 64
+    assert sweep["compile_s_one_program"] > 0
+
+
+def test_cli_profile_writes_trace(tmp_path, capsys):
+    """--profile must produce a non-empty jax.profiler trace directory and
+    leave the decided-log digest untouched (VERDICT r3 #6: this path had
+    never been executed)."""
+    tdir = tmp_path / "trace"
+    rc = cli.main(FLAG_SETS["raft"] + ["--engine", "tpu",
+                                       "--profile", str(tdir)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    traced = list(tdir.rglob("*"))
+    assert any(f.is_file() for f in traced), "no trace files written"
+    rc = cli.main(FLAG_SETS["raft"] + ["--engine", "tpu"])
+    assert rc == 0
+    plain = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["digest"] == plain["digest"]
+
+
 def test_cli_fsweep_requires_pbft_tpu():
     with pytest.raises(SystemExit):
         cli.main(["--protocol", "raft", "--engine", "tpu",
                   "--f-sweep", "1..4"])
+
+
+def test_cli_fsweep_rejects_bcast_fault_model():
+    # The sweep path runs the dense SPEC §6 kernel; silently returning
+    # edge-model results for a §6b request would mislabel the run.
+    with pytest.raises(SystemExit):
+        cli.main(["--protocol", "pbft", "--engine", "tpu",
+                  "--fault-model", "bcast", "--f-sweep", "1,2"])
 
 
 def test_cli_rejects_tpu_flags_on_cpu_engine():
