@@ -86,6 +86,12 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
                     f"pbft requires n_nodes == 3f+1 == {expect}, got {self.n_nodes}")
             if self.n_byzantine > self.f:
                 raise ValueError("n_byzantine must be <= f")
+        if self.n_byzantine < 0 or self.n_byzantine > self.n_nodes:
+            raise ValueError("n_byzantine must be in [0, n_nodes]")
+        if self.n_byzantine > 0 and self.protocol not in ("pbft", "raft"):
+            raise ValueError(
+                f"n_byzantine is a pbft/raft adversary (SPEC §6/§3c); "
+                f"{self.protocol} would silently ignore it")
         if self.byz_mode not in ("silent", "equivocate"):
             raise ValueError(f"unknown byz_mode {self.byz_mode!r}")
         if self.fault_model not in ("edge", "bcast"):

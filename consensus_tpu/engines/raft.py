@@ -97,6 +97,14 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
 
     deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+    # SPEC §3c Raft byzantine minority (ids >= N - n_byzantine):
+    # "silent" withholds every send (votes, acks, heartbeats); state
+    # updates stay normal. "equivocate" double-grants: a byz node's vote
+    # response goes to EVERY delivered candidate, ignoring term and
+    # log-up-to-date checks — the election-safety attack.
+    honest = idx < (N - cfg.n_byzantine)
+    withhold = cfg.n_byzantine > 0 and cfg.byz_mode == "silent"
+    double_grant = cfg.n_byzantine > 0 and cfg.byz_mode == "equivocate"
 
     term, role, voted_for = st.term, st.role, st.voted_for
     log_term, log_val, log_len = st.log_term, st.log_val, st.log_len
@@ -130,6 +138,8 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
 
     # ---- P2 election. Requests snapshot (post-P1).
     was_cand = role == ROLE_C
+    if withhold:
+        was_cand &= honest  # byz candidates never broadcast requests
     req_term, req_lidx = term, log_len
     req_lterm = _last_term(log_term, log_len)
 
@@ -157,8 +167,14 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     reset |= granted
 
     # P2c tally: votes[c] = 1 + Σ_j [grant_j == c ∧ delivered(j, c)].
-    votes = 1 + jnp.sum((grant[:, None] == idx[None, :]) & deliver, axis=0,
-                        dtype=jnp.int32)
+    resp = (grant[:, None] == idx[None, :]) & deliver
+    if withhold:
+        resp &= honest[:, None]  # byz vote responses never travel
+    if double_grant:
+        # Byz j's response reaches EVERY candidate whose request it got.
+        byz_votes = (~honest)[:, None] & was_cand[None, :] & deliver.T & deliver
+        resp = jnp.where((~honest)[:, None], byz_votes, resp)
+    votes = 1 + jnp.sum(resp, axis=0, dtype=jnp.int32)
     win = (role == ROLE_C) & (votes >= majority)
     role = jnp.where(win, ROLE_L, role)
     timer = jnp.where(win, 0, timer)
@@ -179,7 +195,7 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     match_idx = jnp.where(eye & can_prop[:, None], log_len[:, None], match_idx)
 
     # ---- P3b snapshot sender state (post-(a), commit pre-(e)).
-    was_leader = lead
+    was_leader = lead & honest if withhold else lead
     s_term, s_len, s_commit = term, log_len, commit
     s_next, s_logt, s_logv = next_idx, log_term, log_val
 
@@ -225,6 +241,8 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     # ---- P3d leaders process acks. ackm[j, l] = ack_to[j]==l ∧ delivered(j, l).
     still_lead = was_leader & (role == ROLE_L)
     ackm = (ack_to[:, None] == idx[None, :]) & deliver
+    if withhold:
+        ackm &= honest[:, None]  # byz acks never travel
     t_in3 = jnp.max(jnp.where(ackm, ack_term[:, None], 0), axis=0)
     bump3 = still_lead & (t_in3 > term)
     term, role, voted_for, timeout = bump(bump3, t_in3, term, role, voted_for, timeout)

@@ -107,6 +107,10 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
         return _edges(seed, ur, src, dst, cfg.drop_cutoff, cfg.partition_cutoff)
 
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+    # SPEC §3c byzantine minority — same masks as the dense kernel.
+    honest = idx < (N - cfg.n_byzantine)
+    withhold = cfg.n_byzantine > 0 and cfg.byz_mode == "silent"
+    double_grant = cfg.n_byzantine > 0 and cfg.byz_mode == "equivocate"
 
     term, role, voted_for = st.term, st.role, st.voted_for
     log_term, log_val, log_len = st.log_term, st.log_val, st.log_len
@@ -139,7 +143,10 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
                         timeout)
 
     # ---- P2 election over the active candidate set (SPEC §3b).
-    cand_ids = _top_active(role == ROLE_C, term, idx, A)       # [A]
+    cand_mask = role == ROLE_C
+    if withhold:
+        cand_mask &= honest  # byz candidates never broadcast (SPEC §3c)
+    cand_ids = _top_active(cand_mask, term, idx, A)            # [A]
     cvalid = cand_ids >= 0
     cid = jnp.clip(cand_ids, 0, N - 1)
     req_term = jnp.where(cvalid, term[cid], 0)
@@ -172,8 +179,13 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
 
     # P2c tally per active candidate; winners become leaders.
     del_jc = dedge(idx[:, None], cand_ids[None, :])            # [N, A]
-    votes = 1 + jnp.sum((grant[:, None] == cand_ids[None, :]) & del_jc,
-                        axis=0, dtype=jnp.int32)               # [A]
+    resp = (grant[:, None] == cand_ids[None, :]) & del_jc
+    if withhold:
+        resp &= honest[:, None]
+    if double_grant:
+        byz_votes = (~honest)[:, None] & cvalid[None, :] & del_cj.T & del_jc
+        resp = jnp.where((~honest)[:, None], byz_votes, resp)
+    votes = 1 + jnp.sum(resp, axis=0, dtype=jnp.int32)         # [A]
     win = cvalid & (role[cid] == ROLE_C) & (votes >= majority)
     win_id = jnp.where(win, cid, N)                            # N ⇒ dropped
     role = role.at[win_id].set(ROLE_L, mode="drop")
@@ -212,6 +224,8 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
 
     # ---- P3b snapshot tracked-sender state.
     was_lead_k = lvalid & lead[lid]
+    if withhold:
+        was_lead_k &= honest[lid]  # byz heartbeats never travel
     s_term, s_len, s_commit = term[lid], log_len[lid], commit[lid]
     s_next = lead_next
     s_logt, s_logv = log_term[lid], log_val[lid]               # [A, L]
@@ -263,6 +277,8 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     still_lead_k = was_lead_k & (role[lid] == ROLE_L)
     del_jl = dedge(idx[:, None], jnp.where(was_lead_k, lead_id, NONE)[None, :])
     ackm = (ack_slot[:, None] == jnp.arange(A)[None, :]) & del_jl  # [N, A]
+    if withhold:
+        ackm &= honest[:, None]  # byz acks never travel
     t_in3 = jnp.max(jnp.where(ackm, ack_term[:, None], 0), axis=0)  # [A]
     bump3_k = still_lead_k & (t_in3 > term[lid])
     bump3_id = jnp.where(bump3_k, lid, N)

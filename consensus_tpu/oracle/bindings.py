@@ -40,7 +40,7 @@ def get_lib() -> ctypes.CDLL:
     lib.ctpu_delivery_u32.restype = u32
     lib.ctpu_delivery_u32.argtypes = [u64, u32, u32, u32]
     lib.ctpu_raft_run.restype = ctypes.c_int
-    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 10 + [p32] * 5
+    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 12 + [p32] * 5
     p8 = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
     lib.ctpu_paxos_run.restype = ctypes.c_int
     lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 7 + [p32, p8, p32, p32, p32]
@@ -78,6 +78,7 @@ def raft_run(cfg, sweep: int = 0):
         seed, N, cfg.n_rounds, L, cfg.max_entries, cfg.t_min, cfg.t_max,
         cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
         cfg.max_active,
+        cfg.n_byzantine, 1 if cfg.byz_mode == "equivocate" else 0,
         out["commit"], out["log_term"].reshape(-1), out["log_val"].reshape(-1),
         out["term"], out["role"])
     if rc != 0:

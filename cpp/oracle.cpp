@@ -74,6 +74,9 @@ struct RaftSim {
   uint32_t N, R, L, E, t_min, t_max;
   uint32_t drop_cut, part_cut, churn_cut;
   uint32_t A = 0;  // max_active: 0 = dense (SPEC §3), >0 = capped (SPEC §3b)
+  // SPEC §3c byzantine minority (ids >= N - n_byz): byz_equiv = 0 ->
+  // "silent" (withhold every send), 1 -> "equivocate" (double-grant).
+  uint32_t n_byz = 0, byz_equiv = 0;
 
   // State, struct-of-arrays to mirror the array schema (SURVEY.md §7).
   std::vector<uint32_t> term, role, log_len, commit, timer, timeout;
@@ -93,6 +96,10 @@ struct RaftSim {
   uint32_t draw_timeout(uint32_t t, uint32_t i) const {
     return t_min + random_u32(seed, STREAM_TIMEOUT, t, 0, i) % (t_max - t_min);
   }
+
+  bool honest(uint32_t i) const { return i < N - n_byz; }
+  bool withhold() const { return n_byz > 0 && byz_equiv == 0; }
+  bool dbl_grant() const { return n_byz > 0 && byz_equiv == 1; }
 
   // SPEC §3 term-change rule (non-candidacy causes).
   void bump_term(uint32_t i, uint32_t T) {
@@ -156,7 +163,8 @@ struct RaftSim {
     std::vector<uint8_t> was_cand(N);
     std::vector<uint32_t> req_term(N), req_lidx(N), req_lterm(N);
     for (uint32_t c = 0; c < N; ++c) {
-      was_cand[c] = role[c] == ROLE_C;
+      was_cand[c] = role[c] == ROLE_C &&
+                    (!withhold() || honest(c));  // SPEC §3c silent byz
       req_term[c] = term[c];
       req_lidx[c] = log_len[c];
       req_lterm[c] = log_len[c] ? lt(c, log_len[c] - 1) : 0;
@@ -192,8 +200,18 @@ struct RaftSim {
     for (uint32_t c = 0; c < N; ++c) {
       if (role[c] != ROLE_C) continue;  // may have been bumped in P2a
       uint32_t votes = 1;  // self
-      for (uint32_t j = 0; j < N; ++j)
-        if (j != c && grant[j] == int32_t(c) && net.delivered(j, c)) ++votes;
+      for (uint32_t j = 0; j < N; ++j) {
+        if (j == c) continue;
+        if (dbl_grant() && !honest(j)) {
+          // SPEC §3c equivocate: byz j responds to EVERY delivered
+          // candidate request, ignoring term/up-to-date checks.
+          if (was_cand[c] && net.delivered(c, j) && net.delivered(j, c))
+            ++votes;
+        } else if ((!withhold() || honest(j)) && grant[j] == int32_t(c) &&
+                   net.delivered(j, c)) {
+          ++votes;
+        }
+      }
       if (votes >= majority) {
         role[c] = ROLE_L;
         timer[c] = 0; reset[c] = 1;
@@ -218,7 +236,8 @@ struct RaftSim {
     s_next = next_idx;
     std::vector<uint32_t> s_logt = log_term, s_logv = log_val;
     for (uint32_t l = 0; l < N; ++l) {
-      was_leader[l] = role[l] == ROLE_L;
+      was_leader[l] = role[l] == ROLE_L &&
+                      (!withhold() || honest(l));  // SPEC §3c silent byz
       s_term[l] = term[l]; s_len[l] = log_len[l]; s_commit[l] = commit[l];
     }
     // (c) receivers.
@@ -262,11 +281,13 @@ struct RaftSim {
       if (!was_leader[l] || role[l] != ROLE_L) continue;
       uint32_t T = term[l];
       for (uint32_t j = 0; j < N; ++j)
-        if (ack_to[j] == int32_t(l) && net.delivered(j, l))
+        if (ack_to[j] == int32_t(l) && net.delivered(j, l) &&
+            (!withhold() || honest(j)))
           T = std::max(T, ack_term[j]);
       if (T > term[l]) { bump_term(l, T); continue; }
       for (uint32_t j = 0; j < N; ++j) {
         if (ack_to[j] != int32_t(l) || !net.delivered(j, l)) continue;
+        if (withhold() && !honest(j)) continue;  // byz acks never travel
         if (ack_ok[j]) {
           mi(l, j) = std::max(mi(l, j), ack_match[j]);
           ni(l, j) = mi(l, j) + 1;
@@ -318,7 +339,9 @@ struct RaftSim {
 
     // ---- P2 election over the active candidate set.
     std::vector<uint8_t> is_cand(N);
-    for (uint32_t i = 0; i < N; ++i) is_cand[i] = role[i] == ROLE_C;
+    for (uint32_t i = 0; i < N; ++i)
+      is_cand[i] = role[i] == ROLE_C &&
+                   (!withhold() || honest(i));  // SPEC §3c silent byz
     const std::vector<int32_t> cand_ids = top_active(is_cand);
     std::vector<uint8_t> active_cand(N, 0);
     for (int32_t c : cand_ids)
@@ -364,8 +387,17 @@ struct RaftSim {
       uint32_t c = uint32_t(ci);
       if (role[c] != ROLE_C) continue;  // may have been bumped in P2a
       uint32_t votes = 1;  // self
-      for (uint32_t j = 0; j < N; ++j)
-        if (j != c && grant[j] == int32_t(c) && net.delivered(j, c)) ++votes;
+      for (uint32_t j = 0; j < N; ++j) {
+        if (j == c) continue;
+        if (dbl_grant() && !honest(j)) {
+          // SPEC §3c equivocate: byz j responds to EVERY delivered
+          // active candidate request.
+          if (net.delivered(c, j) && net.delivered(j, c)) ++votes;
+        } else if ((!withhold() || honest(j)) && grant[j] == int32_t(c) &&
+                   net.delivered(j, c)) {
+          ++votes;
+        }
+      }
       if (votes >= majority) { role[c] = ROLE_L; timer[c] = 0; reset[c] = 1; }
     }
 
@@ -416,7 +448,8 @@ struct RaftSim {
     for (uint32_t k = 0; k < A; ++k) {
       if (lead_id[k] < 0) continue;
       const uint32_t l = uint32_t(lead_id[k]);
-      was_lead_k[k] = role[l] == ROLE_L;
+      was_lead_k[k] = role[l] == ROLE_L &&
+                      (!withhold() || honest(l));  // SPEC §3c silent byz
       s_term[k] = term[l]; s_len[k] = log_len[l]; s_commit[k] = commit[l];
     }
 
@@ -467,11 +500,13 @@ struct RaftSim {
       if (role[l] != ROLE_L) continue;
       uint32_t T = term[l];
       for (uint32_t j = 0; j < N; ++j)
-        if (ack_slot[j] == int32_t(k) && net.delivered(j, l))
+        if (ack_slot[j] == int32_t(k) && net.delivered(j, l) &&
+            (!withhold() || honest(j)))
           T = std::max(T, ack_term[j]);
       if (T > term[l]) { bump_term(l, T); continue; }
       for (uint32_t j = 0; j < N; ++j) {
         if (ack_slot[j] != int32_t(k) || !net.delivered(j, l)) continue;
+        if (withhold() && !honest(j)) continue;  // byz acks never travel
         uint32_t& m = lead_match[size_t(k) * N + j];
         uint32_t& nx = lead_next[size_t(k) * N + j];
         if (ack_ok[j]) {
@@ -959,6 +994,7 @@ class RaftEngine final : public Engine {
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
     sim_.A = c.max_active;
+    sim_.n_byz = c.n_byzantine; sim_.byz_equiv = c.byz_equivocate;
     sim_.run();
     return 0;
   }
@@ -1105,17 +1141,22 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t t_min, uint32_t t_max,
                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
                   uint32_t max_active,     // 0 = dense; >0 = SPEC §3b cap
+                  uint32_t n_byzantine,    // SPEC §3c minority size
+                  uint32_t byz_equivocate, // 0 silent, 1 double-grant
                   uint32_t* out_commit,    // [N]
                   uint32_t* out_log_term,  // [N*L]
                   uint32_t* out_log_val,   // [N*L]
                   uint32_t* out_term,      // [N]
                   uint32_t* out_role) {    // [N]
-  if (n_nodes == 0 || t_max <= t_min || max_active > n_nodes) return 1;
+  if (n_nodes == 0 || t_max <= t_min || max_active > n_nodes ||
+      n_byzantine > n_nodes)
+    return 1;
   ctpu::RaftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
   sim.E = max_entries; sim.t_min = t_min; sim.t_max = t_max;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.A = max_active;
+  sim.n_byz = n_byzantine; sim.byz_equiv = byz_equivocate;
   sim.run();
   std::memcpy(out_commit, sim.commit.data(), sizeof(uint32_t) * n_nodes);
   std::memcpy(out_log_term, sim.log_term.data(),

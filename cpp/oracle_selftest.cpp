@@ -15,8 +15,9 @@
 
 extern "C" {
 int ctpu_raft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t*,
-                  uint32_t*, uint32_t*, uint32_t*, uint32_t*);
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t*, uint32_t*, uint32_t*, uint32_t*,
+                  uint32_t*);
 int ctpu_pbft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint8_t*, uint32_t*, uint32_t*);
@@ -61,15 +62,28 @@ int main() {
     const uint32_t N = 7, R = 96, L = 64, E = 40;
     size_t W = N + 2 * size_t(N) * L + N + N;
     rc |= run_twice("raft", W, [&](uint32_t* o) {
-      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, o,
-                           o + N, o + N + size_t(N) * L,
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
+                           o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // Capped engine (SPEC §3b): same shapes, max_active = 3.
     rc |= run_twice("raft-capped", W, [&](uint32_t* o) {
-      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, o,
-                           o + N, o + N + size_t(N) * L,
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
+                           o, o + N, o + N + size_t(N) * L,
+                           o + N + 2 * size_t(N) * L,
+                           o + 2 * N + 2 * size_t(N) * L);
+    });
+    // SPEC §3c adversaries: withholding and double-granting minorities.
+    rc |= run_twice("raft-byz-silent", W, [&](uint32_t* o) {
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 2, 0,
+                           o, o + N, o + N + size_t(N) * L,
+                           o + N + 2 * size_t(N) * L,
+                           o + 2 * N + 2 * size_t(N) * L);
+    });
+    rc |= run_twice("raft-byz-equiv", W, [&](uint32_t* o) {
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 2, 1,
+                           o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
