@@ -9,3 +9,56 @@ from consensus_tpu.network import simulator
 @functools.lru_cache(maxsize=None)
 def run_cached(cfg):
     return simulator.run(cfg, warmup=False)
+
+
+def committed_prefixes_agree(res, nodes, sweep) -> bool:
+    """True iff every pair of ``nodes``' committed prefixes agrees in
+    ``sweep`` (State-Machine Safety over a RunResult's decided records)."""
+    import numpy as np
+
+    for a, i in enumerate(nodes):
+        for j in nodes[a + 1:]:
+            c = int(min(res.counts[sweep, i], res.counts[sweep, j]))
+            if c > 0 and (
+                    not np.array_equal(res.rec_a[sweep, i, :c],
+                                       res.rec_a[sweep, j, :c])
+                    or not np.array_equal(res.rec_b[sweep, i, :c],
+                                          res.rec_b[sweep, j, :c])):
+                return False
+    return True
+
+
+@functools.lru_cache(maxsize=None)
+def trace_raft_rounds(cfg, sweep: int | None = 0):
+    """Per-round {role, term, commit, log_term, log_val} numpy arrays for
+    round-granular invariant checks (Election Safety / Leader Completeness
+    need per-term winners and commit timing, which final states cannot
+    reconstruct). Shapes are [R, ...] for a single ``sweep``, or
+    [R, B, ...] over all sweeps with ``sweep=None``. Uses the dense SPEC §3
+    kernel with the runner's per-sweep seed derivation (lo32(seed + b))."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensus_tpu.engines.raft import raft_init, raft_round
+    from consensus_tpu.network.runner import make_seeds
+
+    assert cfg.max_active == 0, "trace helper uses the dense engine"
+
+    def go(seed):
+        def body(c, r):
+            c2 = raft_round(cfg, c, r)
+            return c2, (c2.role, c2.term, c2.commit, c2.log_term, c2.log_val)
+        _, out = jax.lax.scan(body, raft_init(cfg, seed),
+                              jnp.arange(cfg.n_rounds, dtype=jnp.int32))
+        return out
+
+    seeds = make_seeds(cfg)
+    if sweep is None:
+        out = jax.jit(jax.vmap(go, in_axes=0, out_axes=1))(jnp.asarray(seeds))
+    else:
+        out = jax.jit(go)(seeds[sweep])
+    role, term, commit, log_term, log_val = out
+    return {"role": np.asarray(role), "term": np.asarray(term),
+            "commit": np.asarray(commit), "log_term": np.asarray(log_term),
+            "log_val": np.asarray(log_val)}

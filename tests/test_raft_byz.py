@@ -1,0 +1,139 @@
+"""SPEC §3c Raft byzantine minority: JAX↔oracle byte-equivalence for both
+byz modes on the dense (§3) and capped (§3b) engines, liveness degradation
+under `silent`, and the SPEC-promised demonstration that `equivocate` with
+enough byz voters elects two leaders in one term and diverges honest logs
+(Raft is NOT Byzantine fault-tolerant — the simulator shows the attack).
+
+Every byz branch in engines/raft.py (withhold/double_grant in P2/P3) and
+engines/raft_sparse.py has a differential test here (VERDICT r4 weak #3).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from consensus_tpu import Config
+
+from helpers import committed_prefixes_agree, run_cached, trace_raft_rounds
+
+
+def _cfg(**kw):
+    base = dict(protocol="raft", n_nodes=5, n_rounds=96, log_capacity=64,
+                max_entries=40, n_sweeps=2, seed=17, n_byzantine=1,
+                byz_mode="silent")
+    base.update(kw)
+    return Config(**base)
+
+
+# Coverage grid: both modes x {dense, capped} x {clean, dropped, hostile}.
+# Capped rows exercise raft_sparse.py's byz branches (active-set exclusion
+# of silent byz candidates, edge-wise double-grant tally).
+CONFIGS = [
+    ("silent-dense", _cfg()),
+    ("silent-dense-drops", _cfg(n_byzantine=2, drop_rate=0.2, seed=23)),
+    ("silent-dense-hostile", _cfg(n_nodes=9, n_byzantine=3, drop_rate=0.3,
+                                  partition_rate=0.15, churn_rate=0.05,
+                                  n_rounds=128, seed=29)),
+    ("equiv-dense", _cfg(byz_mode="equivocate", n_byzantine=2,
+                         drop_rate=0.25, seed=0)),
+    ("equiv-dense-hostile", _cfg(byz_mode="equivocate", n_nodes=9,
+                                 n_byzantine=4, drop_rate=0.35,
+                                 churn_rate=0.1, n_rounds=128, seed=31)),
+    ("silent-capped", _cfg(max_active=2, n_byzantine=2, drop_rate=0.2,
+                           seed=37)),
+    ("silent-capped-wide", _cfg(max_active=4, n_nodes=11, n_byzantine=4,
+                                drop_rate=0.3, churn_rate=0.1, seed=41)),
+    ("equiv-capped", _cfg(max_active=2, byz_mode="equivocate",
+                          n_byzantine=2, drop_rate=0.25, seed=43)),
+    ("equiv-capped-wide", _cfg(max_active=4, n_nodes=11, byz_mode="equivocate",
+                               n_byzantine=5, drop_rate=0.35, seed=47)),
+]
+
+
+@pytest.mark.parametrize("tag,cfg", CONFIGS, ids=[t for t, _ in CONFIGS])
+def test_byz_differential_vs_oracle(tag, cfg):
+    tpu = run_cached(dataclasses.replace(cfg, engine="tpu"))
+    cpu = run_cached(dataclasses.replace(cfg, engine="cpu"))
+    assert tpu.payload == cpu.payload, (tag, tpu.digest, cpu.digest)
+
+
+def test_capped_byz_equals_dense_when_cap_not_binding():
+    """With A = N the §3b active set never suppresses anyone, so the capped
+    byz semantics must reproduce the dense byz decided logs bit-for-bit."""
+    for mode in ("silent", "equivocate"):
+        base = _cfg(byz_mode=mode, n_byzantine=2, drop_rate=0.2, seed=53)
+        dense = run_cached(base)
+        capped = run_cached(dataclasses.replace(base, max_active=5))
+        assert dense.payload == capped.payload, mode
+
+
+def test_silent_majority_minority_kills_liveness():
+    """SPEC §3c silent: byz nodes send nothing. With 3 byz of N=5 the
+    honest subset (2) is below majority (3), so no candidate can ever
+    assemble a quorum — no leader, no commits, on every sweep and seed."""
+    cfg = _cfg(n_byzantine=3, n_sweeps=4, seed=59)
+    res = run_cached(cfg)
+    assert res.counts.max() == 0
+    out = run_cached(dataclasses.replace(cfg, engine="cpu"))
+    assert out.counts.max() == 0
+
+
+def test_silent_degrades_liveness_vs_clean():
+    """With 2 byz of N=5 silent, commit quorums need all three honest acks
+    per round; under drops, progress is measurably slower than the same
+    seeds with no byz nodes (liveness degradation, SPEC §3c)."""
+    byz = run_cached(_cfg(n_byzantine=2, drop_rate=0.25, n_sweeps=4,
+                          n_rounds=48, max_entries=100, log_capacity=128,
+                          seed=61))
+    clean = run_cached(_cfg(n_byzantine=0, drop_rate=0.25, n_sweeps=4,
+                            n_rounds=48, max_entries=100, log_capacity=128,
+                            seed=61))
+    assert byz.counts.sum() < clean.counts.sum()
+
+
+def test_silent_preserves_safety():
+    """Withholding messages is within Raft's fault model: committed
+    prefixes of ALL nodes (byz ones update state normally) must agree."""
+    cfg = _cfg(n_byzantine=2, drop_rate=0.3, churn_rate=0.1, n_sweeps=4,
+               n_rounds=128, seed=67)
+    res = run_cached(cfg)
+    for b in range(cfg.n_sweeps):
+        assert committed_prefixes_agree(res, list(range(cfg.n_nodes)), b)
+
+
+# --- the election-safety attack (SPEC §3c equivocate) -----------------------
+
+# Verified by seed search: sweep seed 0 at drop_rate=0.25 elects two honest
+# leaders in term 1 (nodes 0 and 1) and diverges honest committed logs.
+ATTACK = Config(protocol="raft", n_nodes=5, n_rounds=128, log_capacity=64,
+                max_entries=40, n_sweeps=1, seed=0, drop_rate=0.25,
+                n_byzantine=2, byz_mode="equivocate")
+
+
+def test_equivocate_elects_two_leaders_one_term():
+    """The attack works: some term has >= 2 distinct winners (Election
+    Safety broken), which honest-node Raft makes impossible."""
+    trace = trace_raft_rounds(ATTACK)
+    winners = {}
+    for r in range(ATTACK.n_rounds):
+        for i in np.nonzero(trace["role"][r] == 2)[0]:
+            winners.setdefault(int(trace["term"][r, i]), set()).add(int(i))
+    multi = {t: w for t, w in winners.items() if len(w) > 1}
+    assert multi, f"attack did not fire; winners per term: {winners}"
+
+
+def test_equivocate_diverges_honest_committed_logs():
+    """State-Machine Safety broken among HONEST nodes: two committed
+    prefixes disagree — the observable damage of the split election."""
+    res = run_cached(ATTACK)
+    H = ATTACK.n_nodes - ATTACK.n_byzantine
+    assert not committed_prefixes_agree(res, list(range(H)), 0), \
+        "honest committed logs did not diverge"
+
+
+def test_equivocate_attack_is_engine_exact():
+    """However broken the run, both engines must agree byte-for-byte —
+    the adversary is a deterministic function of the same draws."""
+    tpu = run_cached(dataclasses.replace(ATTACK, engine="tpu"))
+    cpu = run_cached(dataclasses.replace(ATTACK, engine="cpu"))
+    assert tpu.payload == cpu.payload

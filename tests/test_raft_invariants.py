@@ -1,5 +1,10 @@
 """Raft safety invariants over whole runs (SPEC §3; Raft Fig. 3), checked on
-the TPU engine under adversarial seeds (SURVEY.md §4.2)."""
+the TPU engine under adversarial seeds (SURVEY.md §4.2): State-Machine
+Safety and Log Matching on final states; Election Safety and Leader
+Completeness on per-round traces (they are statements about *when* leaders
+exist and what they held at election time). The companion demonstration
+that Election Safety *fails* under the §3c equivocate adversary lives in
+tests/test_raft_byz.py (the invariants here assume honest nodes)."""
 import dataclasses
 
 import numpy as np
@@ -8,7 +13,7 @@ import pytest
 from consensus_tpu import Config
 from consensus_tpu.network import simulator
 
-from helpers import run_cached
+from helpers import committed_prefixes_agree, run_cached, trace_raft_rounds
 
 CFGS = [
     Config(protocol="raft", n_nodes=5, n_rounds=96, log_capacity=128,
@@ -25,16 +30,8 @@ def test_state_machine_safety(cfg):
     """All nodes' committed prefixes agree (same (term, val) at same index)."""
     res = run_cached(cfg)
     for b in range(cfg.n_sweeps):
-        counts = res.counts[b]
-        for i in range(cfg.n_nodes):
-            for j in range(i + 1, cfg.n_nodes):
-                c = int(min(counts[i], counts[j]))
-                np.testing.assert_array_equal(
-                    res.rec_a[b, i, :c], res.rec_a[b, j, :c],
-                    err_msg=f"sweep {b}: committed term divergence {i}/{j}")
-                np.testing.assert_array_equal(
-                    res.rec_b[b, i, :c], res.rec_b[b, j, :c],
-                    err_msg=f"sweep {b}: committed value divergence {i}/{j}")
+        assert committed_prefixes_agree(res, list(range(cfg.n_nodes)), b), \
+            f"sweep {b}: committed prefix divergence"
 
 
 @pytest.mark.parametrize("cfg", CFGS)
@@ -51,6 +48,52 @@ def test_log_matching_final(cfg):
                 np.testing.assert_array_equal(
                     lv[b, i][same], lv[b, j][same],
                     err_msg=f"sweep {b}: log-matching violation {i}/{j}")
+
+
+@pytest.mark.parametrize("cfg", CFGS)
+def test_election_safety(cfg):
+    """At most one leader per term (Raft Fig. 3, Election Safety), tracked
+    over every round of every sweep — precisely the invariant the §3c
+    equivocate adversary breaks (and honest runs must never)."""
+    tr = trace_raft_rounds(cfg, None)
+    for b in range(cfg.n_sweeps):
+        winners: dict[int, set[int]] = {}
+        for r in range(cfg.n_rounds):
+            for i in np.nonzero(tr["role"][r, b] == 2)[0]:
+                winners.setdefault(int(tr["term"][r, b, i]), set()).add(int(i))
+        multi = {t: w for t, w in winners.items() if len(w) > 1}
+        assert not multi, f"sweep {b}: two leaders in a term: {multi}"
+
+
+@pytest.mark.parametrize("cfg", CFGS)
+def test_leader_completeness(cfg):
+    """Every entry committed before round r is present in the log of every
+    node that is leader at round r (Raft Fig. 3, Leader Completeness) —
+    checked against the deepest committed prefix observed so far, whose
+    content is pinned by State-Machine Safety (asserted above)."""
+    tr = trace_raft_rounds(cfg, None)
+    role, commit = tr["role"], tr["commit"]
+    lt, lv = tr["log_term"], tr["log_val"]
+    for b in range(cfg.n_sweeps):
+        cmax = 0                   # deepest commit at any node so far
+        pref_t = pref_v = None     # its content, from the committing node
+        for r in range(cfg.n_rounds):
+            if cmax > 0:
+                for i in np.nonzero(role[r, b] == 2)[0]:
+                    np.testing.assert_array_equal(
+                        lt[r, b, i, :cmax], pref_t,
+                        err_msg=f"sweep {b} round {r}: leader {i} missing "
+                                "committed terms")
+                    np.testing.assert_array_equal(
+                        lv[r, b, i, :cmax], pref_v,
+                        err_msg=f"sweep {b} round {r}: leader {i} missing "
+                                "committed values")
+            deep = int(commit[r, b].max())
+            if deep > cmax:
+                cmax = deep
+                j = int(commit[r, b].argmax())
+                pref_t = lt[r, b, j, :cmax].copy()
+                pref_v = lv[r, b, j, :cmax].copy()
 
 
 def test_partitioned_minority_cannot_commit():
