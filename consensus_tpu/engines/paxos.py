@@ -73,12 +73,15 @@ def paxos_round(cfg: Config, st: PaxosState, r) -> PaxosState:
     new_promised = jnp.maximum(st.promised, p_max)
 
     # Phase 2: promises (only the highest delivered ballot per slot wins).
-    po = jnp.take_along_axis(st.promised, slot_p[None, :].repeat(N, 0), axis=1)
-    npo = jnp.take_along_axis(new_promised, slot_p[None, :].repeat(N, 0), axis=1)
+    # Gather columns by slot_p directly — st.promised[:, slot_p] lowers to
+    # one XLA gather; the earlier take_along_axis(slot_p.repeat(N, 0))
+    # form materialized three [N, P] i32 index matrices (~400 MB each at
+    # the BASELINE.json:10 10k x 10k shape) before gathering.
+    po = st.promised[:, slot_p]                                         # [A, P]
+    npo = new_promised[:, slot_p]
     prom = (is_prop[None, :] & prep_del & resp_del
             & (ballot[None, :] > po) & (ballot[None, :] == npo))        # [A, P]
-    rep_bal = jnp.where(
-        prom, jnp.take_along_axis(st.acc_bal, slot_p[None, :].repeat(N, 0), axis=1), 0)
+    rep_bal = jnp.where(prom, st.acc_bal[:, slot_p], 0)
     n_prom = jnp.sum(prom, axis=0, dtype=jnp.int32)
     best_a = jnp.argmax(rep_bal, axis=0).astype(jnp.int32)  # first max ⇒ lowest id
     best_bal = jnp.max(rep_bal, axis=0)
@@ -98,7 +101,7 @@ def paxos_round(cfg: Config, st: PaxosState, r) -> PaxosState:
     promised2 = jnp.where(has_acc, a_max, new_promised)
 
     # Phase 5: accepted responses → decide.
-    amax_at = jnp.take_along_axis(a_max, slot_p[None, :].repeat(N, 0), axis=1)
+    amax_at = a_max[:, slot_p]                                          # [A, P]
     accd = acc_cond & (ballot[None, :] == amax_at) & resp_del
     n_acc = jnp.sum(accd, axis=0, dtype=jnp.int32)
     decided = proceed & (n_acc >= majority)

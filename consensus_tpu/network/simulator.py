@@ -14,6 +14,7 @@ acceptance criterion (BASELINE.json:2,5).
 """
 from __future__ import annotations
 
+import dataclasses
 import time
 from dataclasses import dataclass
 
@@ -37,6 +38,10 @@ class RunResult:
     # checkpoint-resumed runs skip the warmup execution) — steps_per_sec
     # is then a lower bound, not a steady-state throughput.
     timing_includes_compile: bool = False
+    # Protocol-specific derived outputs (dpos: the SPEC §7 `lib` index),
+    # computed engine-independently from the decided records so both
+    # front doors report the same extras (ADVICE r4).
+    extras: dict = dataclasses.field(default_factory=dict)
 
     @property
     def steps_per_sec(self) -> float:
@@ -86,12 +91,19 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
         wall = time.perf_counter() - t0
 
     counts, rec_a, rec_b, payload = decided_payload(cfg, out)
+    extras = {}
+    if cfg.protocol == "dpos":
+        # For dpos the decided records ARE the chain (counts=chain_len,
+        # rec_b=chain_p), so `lib` derives uniformly for either engine.
+        from ..engines.dpos import lib_index
+        extras["lib"] = lib_index(rec_b, counts, cfg.n_candidates,
+                                  cfg.n_producers)
     return RunResult(
         config=cfg, payload=payload, digest=serialize.digest(payload),
         wall_s=wall,
         node_round_steps=cfg.n_sweeps * cfg.n_nodes * executed_rounds,
         counts=counts, rec_a=np.asarray(rec_a), rec_b=np.asarray(rec_b),
-        timing_includes_compile=timing_includes_compile)
+        timing_includes_compile=timing_includes_compile, extras=extras)
 
 
 def decided_payload(cfg: Config, out: dict):

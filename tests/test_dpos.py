@@ -59,6 +59,18 @@ def test_dpos_lib_matches_oracle(cfg):
         np.testing.assert_array_equal(out["lib"][b], oracle["lib"])
 
 
+def test_dpos_lib_exposed_by_simulator_both_engines():
+    """SPEC §7 `lib` must be reachable through the simulator front door
+    (RunResult.extras) from EITHER engine, not only via dpos_run/bindings
+    (ADVICE r4), and agree with the dpos_run derivation."""
+    from consensus_tpu.engines.dpos import dpos_run
+    tpu = run_cached(BASE)
+    cpu = run_cached(dataclasses.replace(BASE, engine="cpu"))
+    ref = dpos_run(BASE)["lib"]
+    np.testing.assert_array_equal(tpu.extras["lib"], ref)
+    np.testing.assert_array_equal(cpu.extras["lib"], ref)
+
+
 def test_dpos_lib_definition_brute_force():
     """lib[v] must be exactly the largest k whose suffix has >= T
     distinct producers (and lib+1 must violate it) — checked against a
