@@ -8,10 +8,11 @@ a run is `lax.scan` over rounds with sweeps vmapped as a leading batch axis
 loops `match_index`/`append_entries` [B:5] become the gather/scatter and
 running-max updates below).
 
-Everything is int32 on device (TPU x64 is disabled); u32 semantics from
-the spec are preserved because terms/indices stay < 2^31 and RNG words are
-bitcast — byte-equivalence with the uint32 C++ oracle is checked in
-tests/test_raft_differential.py.
+State is int32 on device (TPU x64 is disabled), except match/next
+replication bookkeeping, stored at the narrowest width that holds L+1
+(:func:`_match_dtype`); u32 semantics from the spec are preserved because
+terms/indices stay < 2^31 and RNG words are bitcast — byte-equivalence
+with the uint32 C++ oracle is checked in tests/test_raft_differential.py.
 """
 from __future__ import annotations
 
@@ -40,8 +41,8 @@ class RaftState(NamedTuple):
     commit: jnp.ndarray     # [N] i32
     timer: jnp.ndarray      # [N] i32
     timeout: jnp.ndarray    # [N] i32
-    match_idx: jnp.ndarray  # [N, N] i32 — match_idx[l, j]
-    next_idx: jnp.ndarray   # [N, N] i32
+    match_idx: jnp.ndarray  # [N, N] _match_dtype(L) — match_idx[l, j]
+    next_idx: jnp.ndarray   # [N, N] _match_dtype(L)
 
 
 # Shared kernels live in ops/ (SURVEY.md §7 package layout); the aliases
@@ -56,6 +57,19 @@ def _draw_timeout(seed, t_min, t_max, term, idx):
     return jnp.int32(t_min) + (d % jnp.uint32(t_max - t_min)).astype(jnp.int32)
 
 
+def _match_dtype(L: int):
+    """Storage dtype for match/next replication state. Values are bounded
+    by L+1, so u8 holds them whenever L <= 254 (u16 up to 65534) — the
+    [N, N] (dense) / [A, N] (capped) match arrays are re-read by every
+    commit-advance binary-search iteration, and the round kernel is
+    HBM-bound (docs/PERF.md "next levers"), so a narrower dtype is a
+    direct bandwidth win. Same integer values at any width: decided logs
+    are bit-identical (differential suite) and the oracle keeps u32."""
+    if L <= 254:
+        return jnp.uint8
+    return jnp.uint16 if L <= 65534 else jnp.int32
+
+
 def raft_init(cfg: Config, seed) -> RaftState:
     N, L = cfg.n_nodes, cfg.log_capacity
     seed = jnp.asarray(seed, jnp.uint32)
@@ -68,8 +82,8 @@ def raft_init(cfg: Config, seed) -> RaftState:
         log_val=jnp.zeros((N, L), jnp.int32),
         log_len=z, commit=z, timer=z,
         timeout=_draw_timeout(seed, cfg.t_min, cfg.t_max, z, idx.astype(jnp.uint32)),
-        match_idx=jnp.zeros((N, N), jnp.int32),
-        next_idx=jnp.ones((N, N), jnp.int32),
+        match_idx=jnp.zeros((N, N), _match_dtype(L)),
+        next_idx=jnp.ones((N, N), _match_dtype(L)),
     )
 
 
@@ -89,6 +103,7 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     N, L = cfg.n_nodes, cfg.log_capacity
     E = min(cfg.max_entries, L)
     majority = N // 2 + 1
+    mdt = _match_dtype(L)
     seed = st.seed
     idx = jnp.arange(N, dtype=jnp.int32)
     uidx = idx.astype(jnp.uint32)
@@ -180,8 +195,10 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     timer = jnp.where(win, 0, timer)
     reset |= win
     match_idx = jnp.where(win[:, None],
-                          jnp.where(eye, log_len[:, None], 0), match_idx)
-    next_idx = jnp.where(win[:, None], log_len[:, None] + 1, next_idx)
+                          jnp.where(eye, log_len[:, None], 0),
+                          match_idx).astype(mdt)
+    next_idx = jnp.where(win[:, None], log_len[:, None] + 1,
+                         next_idx).astype(mdt)
 
     # ---- P3a propose.
     lead = role == ROLE_L
@@ -192,7 +209,8 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     log_term = jnp.where(slot_hot, term[:, None], log_term)
     log_val = jnp.where(slot_hot, prop_val[:, None], log_val)
     log_len = log_len + can_prop.astype(jnp.int32)
-    match_idx = jnp.where(eye & can_prop[:, None], log_len[:, None], match_idx)
+    match_idx = jnp.where(eye & can_prop[:, None], log_len[:, None],
+                          match_idx).astype(mdt)
 
     # ---- P3b snapshot sender state (post-(a), commit pre-(e)).
     was_leader = lead & honest if withhold else lead
@@ -214,7 +232,7 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     reset |= has_l
     role = jnp.where(has_l & (role == ROLE_C), ROLE_F, role)
 
-    prev = s_next[ls, idx] - 1                       # [N]
+    prev = s_next[ls, idx].astype(jnp.int32) - 1     # [N] (i32: u8 can't go -1)
     lrow_t = jnp.take(s_logt, ls, axis=0)            # [N, L] leader log rows
     lrow_v = jnp.take(s_logv, ls, axis=0)
     kprev = jnp.clip(prev - 1, 0, L - 1)[:, None]
@@ -251,23 +269,28 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     succ_lj = (ackm & ack_ok[:, None]).T             # [l, j]
     fail_lj = (ackm & ~ack_ok[:, None]).T
     match_idx = jnp.where(proc[:, None] & succ_lj,
-                          jnp.maximum(match_idx, ack_match[None, :]), match_idx)
+                          jnp.maximum(match_idx, ack_match[None, :].astype(mdt)),
+                          match_idx)
     next_idx = jnp.where(
-        proc[:, None] & succ_lj, match_idx + 1,
-        jnp.where(proc[:, None] & fail_lj, jnp.maximum(1, next_idx - 1), next_idx))
+        proc[:, None] & succ_lj, match_idx + jnp.asarray(1, mdt),
+        jnp.where(proc[:, None] & fail_lj,
+                  jnp.maximum(jnp.asarray(1, mdt), next_idx - jnp.asarray(1, mdt)),
+                  next_idx))
 
     # ---- P3e commit advance: majority-th largest of match_idx row,
     # i.e. the largest m with |{j : match_idx[l,j] >= m}| >= majority.
-    # Computed by a fixed-depth binary search over the value range [0, L]
-    # (match_idx <= log_len <= L): ~log2(L) masked [N,N] count-reductions
-    # instead of a full [N,N] jnp.sort — same value bit-for-bit, ~10x
-    # fewer VPU ops (the sort was 45% of the round pre-optimization;
-    # docs/PERF.md "Round-4 attribution").
+    # Computed by a fixed-depth binary search over the value range [0, E]
+    # — match_idx <= log_len <= E = min(max_entries, L), since P3a stops
+    # proposing at E and followers only copy leader logs — so ~log2(E)
+    # masked [N,N] count-reductions instead of a full [N,N] jnp.sort:
+    # same value bit-for-bit, ~10x fewer VPU ops (the sort was 45% of the
+    # round pre-optimization; docs/PERF.md "Round-4 attribution").
     lo = jnp.zeros(N, jnp.int32)            # count_ge(0) = N >= majority
-    hi = jnp.full(N, L + 1, jnp.int32)      # count_ge(L+1) = 0 < majority
-    for _ in range((L + 1).bit_length()):   # halves [lo, hi) to width 1
+    hi = jnp.full(N, E + 1, jnp.int32)      # count_ge(E+1) = 0 < majority
+    for _ in range((E + 1).bit_length()):   # halves [lo, hi) to width 1
         mid = (lo + hi) // 2
-        cnt = jnp.sum((match_idx >= mid[:, None]).astype(jnp.int32), axis=1)
+        cnt = jnp.sum((match_idx >= mid[:, None].astype(mdt)).astype(jnp.int32),
+                      axis=1)
         ok = cnt >= majority
         lo = jnp.where(ok, mid, lo)
         hi = jnp.where(ok, hi, mid)

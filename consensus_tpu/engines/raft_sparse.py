@@ -41,7 +41,8 @@ from ..ops.adversary import bitcast_i32 as _i32
 from ..ops.adversary import delivery_edges as _edges
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
-from .raft import NONE, ROLE_C, ROLE_F, ROLE_L, _draw_timeout, _last_term
+from .raft import (NONE, ROLE_C, ROLE_F, ROLE_L, _draw_timeout, _last_term,
+                   _match_dtype)
 
 I32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -58,8 +59,8 @@ class RaftSparseState(NamedTuple):
     timer: jnp.ndarray       # [N] i32
     timeout: jnp.ndarray     # [N] i32
     lead_id: jnp.ndarray     # [A] i32 — tracked leader ids, NONE when empty
-    lead_match: jnp.ndarray  # [A, N] i32
-    lead_next: jnp.ndarray   # [A, N] i32
+    lead_match: jnp.ndarray  # [A, N] _match_dtype(L)
+    lead_next: jnp.ndarray   # [A, N] _match_dtype(L)
 
 
 def raft_sparse_init(cfg: Config, seed) -> RaftSparseState:
@@ -75,8 +76,8 @@ def raft_sparse_init(cfg: Config, seed) -> RaftSparseState:
         timeout=_draw_timeout(seed, cfg.t_min, cfg.t_max, z,
                               idx.astype(jnp.uint32)),
         lead_id=jnp.full(A, NONE, jnp.int32),
-        lead_match=jnp.zeros((A, N), jnp.int32),
-        lead_next=jnp.ones((A, N), jnp.int32),
+        lead_match=jnp.zeros((A, N), _match_dtype(L)),
+        lead_next=jnp.ones((A, N), _match_dtype(L)),
     )
 
 
@@ -97,6 +98,7 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     N, L, A = cfg.n_nodes, cfg.log_capacity, cfg.max_active
     E = min(cfg.max_entries, L)
     majority = N // 2 + 1
+    mdt = _match_dtype(L)
     seed = st.seed
     idx = jnp.arange(N, dtype=jnp.int32)
     uidx = idx.astype(jnp.uint32)
@@ -201,8 +203,9 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     src_slot = jnp.argmax(same, axis=1)
     nid = jnp.clip(new_ids, 0, N - 1)
     init_match = jnp.where(idx[None, :] == nid[:, None],
-                           log_len[nid][:, None], 0)           # [A, N]
-    init_next = (log_len[nid][:, None] + 1) * jnp.ones((A, N), jnp.int32)
+                           log_len[nid][:, None], 0).astype(mdt)  # [A, N]
+    init_next = ((log_len[nid][:, None] + 1)
+                 * jnp.ones((A, N), jnp.int32)).astype(mdt)
     lead_match = jnp.where(carried[:, None], lead_match[src_slot], init_match)
     lead_next = jnp.where(carried[:, None], lead_next[src_slot], init_next)
     lead_id = new_ids
@@ -220,7 +223,7 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     # Tracked leaders' self-match follows their own append.
     self_pos = jnp.where(lvalid & can_prop[lid], lid, N)
     lead_match = lead_match.at[jnp.arange(A), self_pos].set(
-        log_len[lid], mode="drop")
+        log_len[lid].astype(mdt), mode="drop")
 
     # ---- P3b snapshot tracked-sender state.
     was_lead_k = lvalid & lead[lid]
@@ -247,7 +250,7 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     reset |= has_l
     role = jnp.where(has_l & (role == ROLE_C), ROLE_F, role)
 
-    prev = s_next[kstar, idx] - 1                              # [N]
+    prev = s_next[kstar, idx].astype(jnp.int32) - 1            # [N] (i32: u8 can't go -1)
     lrow_t = s_logt[kstar]                                     # [N, L]
     lrow_v = s_logv[kstar]
     kprev = jnp.clip(prev - 1, 0, L - 1)[:, None]
@@ -291,22 +294,27 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     succ_kj = (ackm & ack_ok[:, None]).T                       # [A, N]
     fail_kj = (ackm & ~ack_ok[:, None]).T
     lead_match = jnp.where(proc[:, None] & succ_kj,
-                           jnp.maximum(lead_match, ack_match[None, :]),
+                           jnp.maximum(lead_match,
+                                       ack_match[None, :].astype(mdt)),
                            lead_match)
     lead_next = jnp.where(
-        proc[:, None] & succ_kj, lead_match + 1,
+        proc[:, None] & succ_kj, lead_match + jnp.asarray(1, mdt),
         jnp.where(proc[:, None] & fail_kj,
-                  jnp.maximum(1, lead_next - 1), lead_next))
+                  jnp.maximum(jnp.asarray(1, mdt),
+                              lead_next - jnp.asarray(1, mdt)),
+                  lead_next))
 
     # ---- P3e commit advance: majority-th largest of each tracked row,
     # via the same fixed-depth binary search as the dense kernel (raft.py
     # P3e) — a [A, N] jnp.sort would be ~300 comparator stages per round
-    # at N=100k; log2(L) masked count-reductions are exact and cheap.
+    # at N=100k; log2(E) masked count-reductions are exact and cheap
+    # (match <= E — see the dense kernel's bound argument).
     lo = jnp.zeros(A, jnp.int32)
-    hi = jnp.full(A, L + 1, jnp.int32)
-    for _ in range((L + 1).bit_length()):
+    hi = jnp.full(A, E + 1, jnp.int32)
+    for _ in range((E + 1).bit_length()):
         mid = (lo + hi) // 2
-        cnt = jnp.sum((lead_match >= mid[:, None]).astype(jnp.int32), axis=1)
+        cnt = jnp.sum((lead_match >= mid[:, None].astype(mdt))
+                      .astype(jnp.int32), axis=1)
         ok = cnt >= majority
         lo = jnp.where(ok, mid, lo)
         hi = jnp.where(ok, hi, mid)

@@ -127,6 +127,12 @@ def load_checkpoint(path, cfg: Config, eng: EngineDef):
         leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
     template = jax.eval_shape(lambda s: _init_template(cfg, eng, s),
                               jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32))
+    # Cast to the template dtypes: an engine may narrow a state field's
+    # storage dtype between versions (e.g. raft match/next i32 -> u8);
+    # the saved integer values are identical, but lax.scan requires the
+    # carry dtype to match what round_fn returns.
+    leaves = [np.asarray(leaf).astype(t.dtype)
+              for leaf, t in zip(leaves, jax.tree.leaves(template))]
     treedef = jax.tree.structure(template)
     return jax.tree.unflatten(treedef, leaves), meta["next_round"]
 

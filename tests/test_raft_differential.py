@@ -23,6 +23,16 @@ ADVERSARIAL = [
     dataclasses.replace(CLEAN, churn_rate=0.1, seed=13, n_sweeps=4),
     dataclasses.replace(CLEAN, n_nodes=9, drop_rate=0.3, partition_rate=0.2,
                         churn_rate=0.05, n_rounds=128, seed=14, n_sweeps=4),
+    # Storage-dtype tiers of the match/next arrays (engines/raft.py
+    # _match_dtype): u8 is covered by every config above; these pin the
+    # u8 saturation boundary (L=254 ⇒ next_idx reaches exactly 255),
+    # the u16 tier, and the i32 tier.
+    dataclasses.replace(CLEAN, log_capacity=254, max_entries=254,
+                        n_rounds=300, n_sweeps=1, seed=16),
+    dataclasses.replace(CLEAN, log_capacity=300, max_entries=260,
+                        n_rounds=96, drop_rate=0.2, seed=15, n_sweeps=2),
+    dataclasses.replace(CLEAN, log_capacity=65600, max_entries=32,
+                        n_rounds=24, n_sweeps=1, seed=17),
 ]
 
 

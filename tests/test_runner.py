@@ -88,6 +88,35 @@ def test_checkpoint_resume_bit_identical(tmp_path):
     _assert_same(base, resumed)
 
 
+def test_checkpoint_from_wider_dtype_resumes_bit_identical(tmp_path):
+    """A checkpoint written before a state field's storage dtype was
+    narrowed (raft match/next i32 -> u8, round 5) must still resume:
+    load_checkpoint casts leaves to the current init-template dtypes.
+    Simulated by widening every saved leaf to its numpy default width."""
+    import dataclasses
+
+    import numpy as np
+    cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
+    base = RUNS["raft"](cfg)
+
+    eng = raft.get_engine()
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    carry = runner._init_jit(cfg, eng, seeds)
+    carry = runner._chunk_jit(cfg, eng, 16, carry, jnp.int32(0))
+    ckpt = tmp_path / "raft.ckpt.npz"
+    runner.save_checkpoint(ckpt, cfg, carry, 16)
+
+    with np.load(ckpt) as z:
+        widened = {k: (z[k] if k == "__meta__"
+                       else np.asarray(z[k], dtype=np.int64)
+                       if np.issubdtype(z[k].dtype, np.integer) else z[k])
+                   for k in z.files}
+    np.savez(ckpt, **widened)
+
+    resumed = raft.raft_run(cfg, checkpoint_path=ckpt, resume=True)
+    _assert_same(base, resumed)
+
+
 def test_checkpoint_config_mismatch_is_ignored(tmp_path):
     import dataclasses
     cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
