@@ -40,6 +40,7 @@ _FLAG_FIELDS = {
     "producers": ("n_producers", 4),
     "epoch_len": ("epoch_len", 16),
     "scan_chunk": ("scan_chunk", 0),
+    "sweep_chunk": ("sweep_chunk", 0),
 }
 _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
                "fault_model": str, "drop_rate": float,
@@ -182,12 +183,20 @@ def main(argv=None) -> int:
             ("--scan-chunk" if "scan_chunk" in typed
              else "config field scan_chunk",
              cfg.scan_chunk),
+            ("--sweep-chunk" if "sweep_chunk" in typed
+             else "config field sweep_chunk",
+             cfg.sweep_chunk),
         ] if on]
         if rejected:
             parser.error(f"{', '.join(rejected)}: only valid with "
                          f"--engine tpu (got --engine {cfg.engine})")
 
     # Usage errors must fail fast — before any accelerator probe.
+    if args.checkpoint and cfg.sweep_chunk and cfg.sweep_chunk < cfg.n_sweeps:
+        parser.error("--checkpoint is not supported with sweep_chunk "
+                     "grouping (one snapshot per group is not a layout "
+                     "anything resumes); use --scan-chunk for mid-run "
+                     "snapshots or drop --sweep-chunk")
     if args.f_sweep:
         if cfg.protocol != "pbft" or cfg.engine != "tpu":
             parser.error("--f-sweep requires --protocol pbft --engine tpu")

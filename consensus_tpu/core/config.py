@@ -71,6 +71,14 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     # Parallelism (TPU engine only; ignored by the oracle).
     mesh_shape: tuple = ()       # e.g. (8,) to shard sweeps/nodes over 8 chips
     scan_chunk: int = 0          # 0 ⇒ single scan; else blocked scan chunk size
+    # 0 ⇒ all sweeps batch into one XLA program; else the host runs
+    # groups of at most this many sweeps as separate programs and
+    # concatenates the carries. Per-sweep seeds (docs/SPEC.md §1) are
+    # position-based, so results are bit-identical to the one-program
+    # run (tests/test_runner.py). Bounds per-program working-set size —
+    # required at e.g. pbft-bcast N=100k where the 8-sweep-batched sort
+    # faults the TPU worker (benchmarks/run_benchmarks.py).
+    sweep_chunk: int = 0
 
     def __post_init__(self) -> None:
         if self.protocol not in PROTOCOLS:
@@ -107,6 +115,8 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
         if self.max_active > self.n_nodes:
             raise ValueError("max_active must be <= n_nodes (the active set "
                              "is a subset of the population, SPEC §3b)")
+        if self.sweep_chunk < 0:
+            raise ValueError("sweep_chunk must be >= 0 (0 = one program)")
 
     # Integer cutoffs — THE values both engines compare draws against.
     @property

@@ -119,10 +119,23 @@ def load_checkpoint(path, cfg: Config, eng: EngineDef):
         return None
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
-        saved_cfg = {k: v for k, v in meta["config"].items() if k != "_cutoffs"}
-        current = json.loads(cfg.to_json())
-        current.pop("_cutoffs", None)
-        if saved_cfg != current:
+        # Round-trip the saved dict through Config so a field added to
+        # the schema AFTER the snapshot was written compares at its
+        # default (a pre-sweep_chunk checkpoint ran with sweep_chunk=0
+        # semantics by definition) instead of silently invalidating
+        # every existing checkpoint via a key-for-key dict mismatch.
+        # Keys NOT in the current schema mean the snapshot came from a
+        # *newer* (or foreign) semantics — reject those rather than
+        # resume a carry whose meaning we can't represent; likewise a
+        # saved config today's validation refuses is a mismatch, not a
+        # crash.
+        saved = {k: v for k, v in meta["config"].items() if k != "_cutoffs"}
+        if not set(saved) <= {f.name for f in dataclasses.fields(Config)}:
+            return None
+        try:
+            if Config.from_json(json.dumps(saved)) != cfg:
+                return None
+        except (ValueError, TypeError):
             return None
         leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
     template = jax.eval_shape(lambda s: _init_template(cfg, eng, s),
@@ -143,12 +156,61 @@ def _init_template(cfg, eng, seeds):
 
 # --- the run loop ------------------------------------------------------------
 
-def _prepare(cfg: Config, eng: EngineDef, mesh):
+def _sweep_groups(cfg: Config, seeds=None):
+    """Split ``cfg`` into (sub-config, seed-slice) groups of at most
+    ``cfg.sweep_chunk`` sweeps, or None when the run is one program.
+    An explicit ``seeds`` vector is sliced instead of regenerated.
+
+    The one-program seed vector (docs/SPEC.md §1: sweep b ⇒
+    lo32(seed + b)) is sliced positionally, so grouping can never change
+    any sweep's trajectory — only which XLA program hosts it. Every
+    full-size group shares one sub-config (the parent's seed field,
+    unused when explicit seeds are passed), so jit re-traces once, not
+    once per group; only a ragged tail adds a second program.
+    """
+    g = cfg.sweep_chunk
+    if not g or g >= cfg.n_sweeps:
+        return None
+    seeds = make_seeds(cfg) if seeds is None else _check_seeds(cfg, seeds)
+    return [(dataclasses.replace(cfg, n_sweeps=min(g, cfg.n_sweeps - s),
+                                 sweep_chunk=0), seeds[s:s + g])
+            for s in range(0, cfg.n_sweeps, g)]
+
+
+def _check_groups(cfg: Config, groups, mesh):
+    """Fail fast on an unshardable group — in particular a ragged tail
+    whose size the mesh sweep axis doesn't divide — BEFORE any group
+    runs, not after minutes of device time on the full-size groups."""
+    if mesh is None and cfg.mesh_shape:
+        mesh = meshlib.make_mesh(cfg.mesh_shape)
+    for sub, _ in groups:
+        meshlib.check_divisible(sub, mesh)
+    return mesh
+
+
+def _concat_carries(carries):
+    return jax.tree.map(lambda *leaves: jnp.concatenate(leaves, axis=0),
+                        *carries)
+
+
+def _check_seeds(cfg: Config, seeds):
+    """An explicit seed vector must cover exactly cfg.n_sweeps — a short
+    one would silently shrink the batch while callers report throughput
+    and digests for the configured sweep count (no silent ignores)."""
+    seeds = np.asarray(seeds)
+    if seeds.shape != (cfg.n_sweeps,):
+        raise ValueError(f"seeds shape {seeds.shape} != (n_sweeps,) = "
+                         f"({cfg.n_sweeps},)")
+    return seeds
+
+
+def _prepare(cfg: Config, eng: EngineDef, mesh, seeds=None):
     """Shared setup: resolve the mesh, check shardability, shard seeds."""
     if mesh is None and cfg.mesh_shape:
         mesh = meshlib.make_mesh(cfg.mesh_shape)
     meshlib.check_divisible(cfg, mesh)
-    seeds = jnp.asarray(make_seeds(cfg))
+    seeds = jnp.asarray(make_seeds(cfg) if seeds is None
+                        else _check_seeds(cfg, seeds))
     if mesh is not None:
         seeds = jax.device_put(seeds, jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(meshlib.SWEEP_AXIS)))
@@ -168,7 +230,7 @@ def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
     return carry
 
 
-def run_device(cfg: Config, eng: EngineDef, *, mesh=None):
+def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
     """Advance a fresh batched carry through ``cfg.n_rounds`` rounds and
     return it ON DEVICE, synchronized via the smallest extract leaf.
 
@@ -178,7 +240,12 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None):
     than a 1k-round scan, and the decided-log extraction is a one-time
     epilogue, not part of the per-round metric (BASELINE.json:2).
     """
-    mesh, seeds = _prepare(cfg, eng, mesh)
+    groups = _sweep_groups(cfg, seeds)
+    if groups is not None:
+        mesh = _check_groups(cfg, groups, mesh)
+        return _concat_carries([run_device(sub, eng, mesh=mesh, seeds=s)
+                                for sub, s in groups])
+    mesh, seeds = _prepare(cfg, eng, mesh, seeds)
     carry = _init_jit(cfg, eng, seeds, mesh=mesh)
     carry = _advance(cfg, eng, carry, 0, cfg.scan_chunk or cfg.n_rounds, mesh)
     smallest = min(eng.extract(carry).values(), key=lambda a: a.size)
@@ -187,7 +254,8 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None):
 
 
 def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
-        resume: bool = False, stats: dict | None = None) -> dict:
+        resume: bool = False, stats: dict | None = None,
+        seeds=None) -> dict:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
@@ -200,7 +268,21 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     this call actually ran (a resumed run skips the first
     ``start_round`` rounds — counting them would inflate steps/sec).
     """
-    mesh, seeds = _prepare(cfg, eng, mesh)
+    groups = _sweep_groups(cfg, seeds)
+    if groups is not None:
+        mesh = _check_groups(cfg, groups, mesh)
+        if checkpoint_path:
+            # A grouped run would need one snapshot per group; nothing
+            # writes or resumes that layout, so reject rather than
+            # checkpoint only the last group (no silent ignores).
+            raise ValueError("checkpointing is not supported with "
+                             "sweep_chunk; use scan_chunk for mid-run "
+                             "snapshots or sweep_chunk=0")
+        outs = [run(sub, eng, mesh=mesh, stats=stats, seeds=s)
+                for sub, s in groups]
+        return {k: np.concatenate([o[k] for o in outs], axis=0)
+                for k in outs[0]}
+    mesh, seeds = _prepare(cfg, eng, mesh, seeds)
 
     start = 0
     carry = None

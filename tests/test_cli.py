@@ -172,6 +172,15 @@ def test_cli_rejects_tpu_flags_on_cpu_engine():
         cli.main(FLAG_SETS["raft"] + ["--engine", "cpu", "--mesh", "2x1"])
 
 
+def test_cli_rejects_checkpoint_with_sweep_chunk(tmp_path):
+    # Must die in arg validation (clean parser.error), not as a raw
+    # ValueError from runner.run after the accelerator probe.
+    with pytest.raises(SystemExit):
+        cli.main(FLAG_SETS["raft"] + ["--engine", "tpu", "--sweeps", "4",
+                                      "--sweep-chunk", "2",
+                                      "--checkpoint", str(tmp_path / "c")])
+
+
 def test_cli_typed_flag_overrides_config_file(tmp_path, capsys):
     cfgfile = tmp_path / "cfg.json"
     args = cli.build_parser().parse_args(FLAG_SETS["raft"] + ["--engine", "cpu"])

@@ -71,6 +71,116 @@ def test_chunked_scan_equals_plain(proto):
     _assert_same(base, RUNS[proto](cfgc))
 
 
+@pytest.mark.parametrize("proto", list(CFGS))
+@pytest.mark.parametrize("chunk", [1, 3])
+def test_sweep_chunk_equals_one_program(proto, chunk):
+    """Grouped-sweep execution (chunk=3 exercises the ragged 4=3+1 tail)
+    is an execution strategy, not a semantic change: per-sweep seeds are
+    position-based, so every sweep's trajectory is bit-identical."""
+    import dataclasses
+    cfg = CFGS[proto]
+    base = RUNS[proto](cfg)
+    cfgs = dataclasses.replace(cfg, sweep_chunk=chunk)
+    _assert_same(base, RUNS[proto](cfgs))
+
+
+def test_sweep_chunk_rejects_checkpoint(tmp_path):
+    import dataclasses
+    cfg = dataclasses.replace(CFGS["raft"], sweep_chunk=2)
+    with pytest.raises(ValueError, match="sweep_chunk"):
+        runner.run(cfg, raft.get_engine(),
+                   checkpoint_path=tmp_path / "ck.npz")
+
+
+def test_sweep_chunk_ragged_tail_unshardable_fails_fast():
+    """4 sweeps grouped by 3 → tail of 1; a 2-wide sweep mesh axis can't
+    shard it. Must raise before any group runs, not mid-run."""
+    import dataclasses
+    cfg = dataclasses.replace(CFGS["raft"], sweep_chunk=3, mesh_shape=(2, 2))
+    with pytest.raises(ValueError, match="divisible"):
+        runner.run(cfg, raft.get_engine())
+
+
+def test_sweep_chunk_honors_explicit_seeds():
+    cfg = CFGS["raft"]
+    eng = raft.get_engine()
+    seeds = np.asarray([17, 3, 29, 11], np.uint32)
+    base = runner.run(cfg, eng, seeds=seeds)
+    import dataclasses
+    grouped = runner.run(dataclasses.replace(cfg, sweep_chunk=3), eng,
+                         seeds=seeds)
+    _assert_same(base, grouped)
+
+
+def test_explicit_seeds_wrong_length_rejected():
+    import dataclasses
+    eng = raft.get_engine()
+    short = np.asarray([1, 2], np.uint32)  # cfg has n_sweeps=4
+    with pytest.raises(ValueError, match="seeds"):
+        runner.run(CFGS["raft"], eng, seeds=short)
+    with pytest.raises(ValueError, match="seeds"):
+        runner.run(dataclasses.replace(CFGS["raft"], sweep_chunk=2), eng,
+                   seeds=short)
+
+
+def test_checkpoint_from_older_schema_still_resumes(tmp_path):
+    """A snapshot written before a Config field existed must compare at
+    that field's default, not be silently invalidated (and restart from
+    round 0) by a key-for-key dict mismatch."""
+    import dataclasses, json
+    cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
+    eng = raft.get_engine()
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    carry = runner._init_jit(cfg, eng, seeds)
+    carry = runner._chunk_jit(cfg, eng, 16, carry, jnp.int32(0))
+    path = tmp_path / "ck.npz"
+    runner.save_checkpoint(path, cfg, carry, 16)
+
+    # Rewrite the snapshot's meta with sweep_chunk deleted, as a file
+    # written by the pre-sweep_chunk schema would have it.
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        meta = json.loads(bytes(z["__meta__"]).decode())
+    del meta["config"]["sweep_chunk"]
+    np.savez(path, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+
+    loaded = runner.load_checkpoint(path, cfg, eng)
+    assert loaded is not None and loaded[1] == 16
+    resumed = runner.run(cfg, eng, checkpoint_path=path, resume=True)
+    _assert_same(RUNS["raft"](cfg), resumed)
+
+
+def test_checkpoint_from_newer_schema_rejected(tmp_path):
+    """A snapshot whose config carries a key the current schema doesn't
+    know encodes semantics we can't represent — reject (restart), don't
+    resume it or crash on it."""
+    import dataclasses, json
+    cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
+    eng = raft.get_engine()
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    carry = runner._init_jit(cfg, eng, seeds)
+    carry = runner._chunk_jit(cfg, eng, 16, carry, jnp.int32(0))
+    path = tmp_path / "ck.npz"
+    runner.save_checkpoint(path, cfg, carry, 16)
+
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        meta = json.loads(bytes(z["__meta__"]).decode())
+    meta["config"]["future_adversary_mode"] = 3
+    np.savez(path, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    assert runner.load_checkpoint(path, cfg, eng) is None
+
+    # An invalid-under-current-validation saved config is likewise a
+    # mismatch (None), not an uncaught ValueError.
+    del meta["config"]["future_adversary_mode"]
+    meta["config"]["t_max"] = meta["config"]["t_min"]
+    np.savez(path, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    assert runner.load_checkpoint(path, cfg, eng) is None
+
+
 def test_checkpoint_resume_bit_identical(tmp_path):
     import dataclasses
     cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
