@@ -220,7 +220,7 @@ def main() -> None:
         results["rows"].append(row)
         _progress(row)
 
-    if not only or any(n.startswith("pbft") for n in only):
+    if not only or any(n.startswith("pbft-fsweep") for n in only):
         if not args.skip_tpu:
             # The measured artifact for BASELINE config 3: the FULL f=1..128
             # ladder in one compiled program ([--quick]: power-of-two rungs).

@@ -64,8 +64,12 @@ def paxos_round(cfg: Config, st: PaxosState, r) -> PaxosState:
     prep_del = deliver.T        # [a, p]: prepare/accept p→a delivered
     resp_del = deliver          # [a, p]: response a→p delivered
 
-    seg_max = jax.vmap(lambda d: jnp.maximum(
-        jax.ops.segment_max(d, slot_p, num_segments=S), 0))
+    # Row-wise per-slot segment reductions. seg_max clamps at 0 (ballots
+    # are positive; empty slots read 0); the raw variants keep the
+    # iinfo fill for arbitrary-valued payloads, masked by the caller.
+    seg_max0 = jax.vmap(
+        lambda d: jax.ops.segment_max(d, slot_p, num_segments=S))
+    seg_max = lambda d: jnp.maximum(seg_max0(d), 0)
 
     # Phase 1: prepares → per-slot max delivered ballot at each acceptor.
     data1 = jnp.where(is_prop[None, :] & prep_del, ballot[None, :], 0)  # [A, P]
@@ -91,28 +95,43 @@ def paxos_round(cfg: Config, st: PaxosState, r) -> PaxosState:
     proceed = is_prop & (n_prom >= majority)
     v_chosen = jnp.where(best_bal > 0, rep_val, v_own)
 
-    # Phase 4: accepts.
+    # Phase 4: accepts. The winning value is NOT gathered as
+    # v_chosen[a_max - (r·N+1)] — a [A, S] arbitrary-index gather from a
+    # [P] vector costs ~780 ms/round at 10k×10k on v5 lite (97% of the
+    # round, measured 2026-07-30). Ballots are distinct across p, so
+    # exactly one proposer per (acceptor, slot) matches the slot's max
+    # ballot: select it with an equality mask and reduce — same result,
+    # rides the fast segment path.
+    I32_MIN = jnp.iinfo(jnp.int32).min
     acc_cond = proceed[None, :] & prep_del & (ballot[None, :] >= npo)   # [A, P]
     a_max = seg_max(jnp.where(acc_cond, ballot[None, :], 0))            # [A, S]
+    amax_at = a_max[:, slot_p]                                          # [A, P]
+    win = acc_cond & (ballot[None, :] == amax_at)   # ≤1 true per (a, slot)
+    val_w = seg_max0(jnp.where(win, v_chosen[None, :], I32_MIN))        # [A, S]
     has_acc = a_max > 0
-    p_star = jnp.clip(a_max - (r * N + 1), 0, N - 1)
     acc_bal2 = jnp.where(has_acc, a_max, st.acc_bal)
-    acc_val2 = jnp.where(has_acc, v_chosen[p_star], st.acc_val)
+    acc_val2 = jnp.where(has_acc, val_w, st.acc_val)
     promised2 = jnp.where(has_acc, a_max, new_promised)
 
     # Phase 5: accepted responses → decide.
-    amax_at = a_max[:, slot_p]                                          # [A, P]
-    accd = acc_cond & (ballot[None, :] == amax_at) & resp_del
+    accd = win & resp_del
     n_acc = jnp.sum(accd, axis=0, dtype=jnp.int32)
     decided = proceed & (n_acc >= majority)
 
-    # Phase 6: decide broadcast; learn from lowest-id decider, first wins.
-    reach = decided[:, None] & (deliver | eye)                          # [p, n]
+    # Phase 6: decide broadcast; learn from lowest-id decider, first
+    # wins. Built directly in [n, p] orientation (prep_del[n, p] IS
+    # p→n delivery) — the [p, n] formulation transposed a [N, N]
+    # matrix per round — and the learned value uses the same
+    # equality-match reduction as phase 4 (the min-id decider is
+    # unique per (receiver, slot)) instead of a v_chosen[pmin] gather.
+    reach_np = decided[None, :] & (prep_del | eye)                      # [n, p]
     seg_min = jax.vmap(lambda d: jnp.minimum(
         jax.ops.segment_min(d, slot_p, num_segments=S), N))
-    pmin = seg_min(jnp.where(reach, idx[:, None], N).T)                 # [n, S]
+    pmin = seg_min(jnp.where(reach_np, idx[None, :], N))                # [n, S]
+    pmin_at = pmin[:, slot_p]                                           # [n, P]
+    winp = reach_np & (idx[None, :] == pmin_at)
+    lv_in = seg_max0(jnp.where(winp, v_chosen[None, :], I32_MIN))       # [n, S]
     found = pmin < N
-    lv_in = v_chosen[jnp.clip(pmin, 0, N - 1)]
     learn_now = found & ~st.learned_mask
     learned_val = jnp.where(learn_now, lv_in, st.learned_val)
     learned_mask = st.learned_mask | found

@@ -223,12 +223,19 @@ def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:
     n_reals = jnp.asarray([3 * f + 1 for f in fs], jnp.int32)
     stF = _fsweep_jit(cfg_pad, jnp.asarray(seeds), n_reals,
                       jnp.asarray(fs, jnp.int32))
+    # Pull each padded array ONCE and slice on the host: per-rung device
+    # slicing issued 3 tiny transfers per rung — ~2·|fs| tunnel
+    # round-trips that dominated the measured wall at |fs|=128 (~26 s
+    # for ~1 s of device time, caught 2026-07-30).
+    committed = np.asarray(stF.committed)
+    dval = np.asarray(stF.dval)
+    view = np.asarray(stF.view)
     out = []
     for k, f in enumerate(fs):
         n = 3 * f + 1
         out.append({
-            "committed": np.asarray(stF.committed[k, :n]),
-            "dval": np.asarray(stF.dval[k, :n]),
-            "view": np.asarray(stF.view[k, :n]),
+            "committed": committed[k, :n],
+            "dval": dval[k, :n],
+            "view": view[k, :n],
         })
     return out

@@ -97,23 +97,45 @@ def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0, *, mesh=No
     return carry
 
 
+@jax.jit
+def _sync_elem(a):
+    """First element of ``a`` — the O(1)-byte device-completion witness
+    run_device's sync barrier transfers (see comment there)."""
+    return a.ravel()[0]
+
+
 # --- checkpointing -----------------------------------------------------------
 
-def save_checkpoint(path, cfg: Config, carry, next_round: int) -> None:
-    """Snapshot the batched carry after ``next_round`` rounds have run."""
+def save_checkpoint(path, cfg: Config, carry, next_round: int,
+                    seeds=None) -> None:
+    """Snapshot the batched carry after ``next_round`` rounds have run.
+
+    ``seeds`` records the per-sweep seed vector the carry was produced
+    with (default: ``make_seeds(cfg)``) so a resume under different
+    explicit seeds is detected as a mismatch, not silently continued.
+    """
     leaves, _ = jax.tree.flatten(carry)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_suffix(".tmp.npz")
+    seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
     np.savez(tmp, __meta__=np.frombuffer(json.dumps(
-        {"config": json.loads(cfg.to_json()), "next_round": next_round}
+        {"config": json.loads(cfg.to_json()), "next_round": next_round,
+         "seeds": [int(s) for s in seeds]}
     ).encode(), dtype=np.uint8), **arrays)
     tmp.replace(path)
 
 
-def load_checkpoint(path, cfg: Config, eng: EngineDef):
-    """Return (carry, next_round) or None if absent / config mismatch."""
+def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None):
+    """Return (carry, next_round) or None if absent / config mismatch.
+
+    ``seeds`` is the seed vector the caller will resume under (default
+    ``make_seeds(cfg)``); a snapshot taken under a different vector is a
+    mismatch — its carry belongs to other trajectories. Snapshots from
+    before seeds were recorded compare at ``make_seeds(cfg)``, which is
+    what they necessarily ran with.
+    """
     path = pathlib.Path(path)
     if not path.exists():
         return None
@@ -136,6 +158,12 @@ def load_checkpoint(path, cfg: Config, eng: EngineDef):
             if Config.from_json(json.dumps(saved)) != cfg:
                 return None
         except (ValueError, TypeError):
+            return None
+        want = make_seeds(cfg) if seeds is None else np.asarray(seeds)
+        have = meta.get("seeds")
+        have = make_seeds(cfg) if have is None else np.asarray(have)
+        if not np.array_equal(want.astype(np.uint32),
+                              have.astype(np.uint32)):
             return None
         leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
     template = jax.eval_shape(lambda s: _init_template(cfg, eng, s),
@@ -218,7 +246,7 @@ def _prepare(cfg: Config, eng: EngineDef, mesh, seeds=None):
 
 
 def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
-             mesh, checkpoint_path=None):
+             mesh, checkpoint_path=None, seeds=None):
     """Drive fixed-shape jitted chunks from ``start`` to ``cfg.n_rounds``."""
     r = start
     while r < cfg.n_rounds:
@@ -226,7 +254,7 @@ def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
         carry = _chunk_jit(cfg, eng, n, carry, jnp.int32(r), mesh=mesh)
         r += n
         if checkpoint_path and r < cfg.n_rounds:
-            save_checkpoint(checkpoint_path, cfg, carry, r)
+            save_checkpoint(checkpoint_path, cfg, carry, r, seeds=seeds)
     return carry
 
 
@@ -243,13 +271,27 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
     groups = _sweep_groups(cfg, seeds)
     if groups is not None:
         mesh = _check_groups(cfg, groups, mesh)
-        return _concat_carries([run_device(sub, eng, mesh=mesh, seeds=s)
-                                for sub, s in groups])
+        carry = _concat_carries([run_device(sub, eng, mesh=mesh, seeds=s)
+                                 for sub, s in groups])
+        # The per-group barriers don't cover the concat itself — sync on
+        # the concatenated result too, or the contract ("returned ON
+        # DEVICE, synchronized") breaks and timed callers leak this
+        # round's concat work into the next timed window.
+        np.asarray(_sync_elem(jax.tree.leaves(carry)[0]))
+        return carry
     mesh, seeds = _prepare(cfg, eng, mesh, seeds)
     carry = _init_jit(cfg, eng, seeds, mesh=mesh)
     carry = _advance(cfg, eng, carry, 0, cfg.scan_chunk or cfg.n_rounds, mesh)
-    smallest = min(eng.extract(carry).values(), key=lambda a: a.size)
-    np.asarray(smallest)  # host sync barrier (tunnel-safe)
+    # Sync barrier, O(1) bytes: transfer a jitted 1-element slice of a
+    # final-carry leaf. The slice program has a data dependency on the
+    # whole round loop, so its 4-byte result reaching the host proves
+    # the computation finished. Two prior barriers were dishonest here
+    # (caught 2026-07-30): pulling the *smallest extract leaf* is O(N·S)
+    # for paxos (100 MB at 10k×10k — the "benchmark" measured the tunnel
+    # at ~27 s/run vs ~0.25 s of device time), and
+    # jax.block_until_ready returns BEFORE device completion on the
+    # tunnel backend (timings collapse to ~0 — not a barrier at all).
+    np.asarray(_sync_elem(jax.tree.leaves(carry)[0]))
     return carry
 
 
@@ -287,7 +329,7 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     start = 0
     carry = None
     if resume and checkpoint_path:
-        loaded = load_checkpoint(checkpoint_path, cfg, eng)
+        loaded = load_checkpoint(checkpoint_path, cfg, eng, seeds=seeds)
         if loaded is not None:
             carry, start = loaded
             carry = jax.device_put(carry)
@@ -306,7 +348,8 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         chunk = min(64, max(1, cfg.n_rounds // 2))
     else:
         chunk = cfg.n_rounds
-    carry = _advance(cfg, eng, carry, start, chunk, mesh, checkpoint_path)
+    carry = _advance(cfg, eng, carry, start, chunk, mesh, checkpoint_path,
+                     seeds=np.asarray(seeds))
 
     if stats is not None:
         stats["start_round"] = start

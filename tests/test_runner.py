@@ -142,6 +142,7 @@ def test_checkpoint_from_older_schema_still_resumes(tmp_path):
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
         meta = json.loads(bytes(z["__meta__"]).decode())
     del meta["config"]["sweep_chunk"]
+    del meta["seeds"]  # pre-recorded-seeds era: implies make_seeds(cfg)
     np.savez(path, __meta__=np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8), **arrays)
 
@@ -149,6 +150,30 @@ def test_checkpoint_from_older_schema_still_resumes(tmp_path):
     assert loaded is not None and loaded[1] == 16
     resumed = runner.run(cfg, eng, checkpoint_path=path, resume=True)
     _assert_same(RUNS["raft"](cfg), resumed)
+
+
+def test_resume_under_different_seeds_is_a_mismatch(tmp_path):
+    """A snapshot's carry belongs to the seed vector that produced it;
+    resuming under different explicit seeds must restart, not continue
+    the old trajectories mislabeled as the new ones."""
+    import dataclasses
+    cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
+    eng = raft.get_engine()
+    seeds_a = np.asarray([7, 8, 9, 10], np.uint32)
+    seeds_b = np.asarray([70, 80, 90, 100], np.uint32)
+    path = tmp_path / "ck.npz"
+
+    carry = runner._init_jit(cfg, eng, jnp.asarray(seeds_a))
+    carry = runner._chunk_jit(cfg, eng, 16, carry, jnp.int32(0))
+    runner.save_checkpoint(path, cfg, carry, 16, seeds=seeds_a)
+
+    assert runner.load_checkpoint(path, cfg, eng, seeds=seeds_a) is not None
+    assert runner.load_checkpoint(path, cfg, eng, seeds=seeds_b) is None
+    # default-seed caller: also a mismatch with this explicit-seed file
+    assert runner.load_checkpoint(path, cfg, eng) is None
+    resumed = runner.run(cfg, eng, checkpoint_path=path, resume=True,
+                         seeds=seeds_b)
+    _assert_same(runner.run(cfg, eng, seeds=seeds_b), resumed)
 
 
 def test_checkpoint_from_newer_schema_rejected(tmp_path):
