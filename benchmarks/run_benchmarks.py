@@ -54,13 +54,12 @@ CONFIGS = {
     # Raft+PBFT sweeps"): the SPEC §6b broadcast-atomic fault model —
     # O(N·S·log N) tallies; the §6 dense [N,N,S] tensors cannot exist at
     # this N. N = 3f+1.
-    # sweep_chunk=2: the 8-sweep-batched [8,16,100k] sort faults the TPU
-    # worker (XLA codegen, observed v5 lite 2026-07-30); 2-sweep programs
-    # are stable and bit-identical (position-based per-sweep seeds).
+    # (The earlier gather-based tally faulted the TPU worker when >=2
+    # sweeps batched into one program — cfg.sweep_chunk bounded it; the
+    # gather-free sorted-space tally needs no grouping at any width.)
     "pbft-100k-bcast": Config(protocol="pbft", fault_model="bcast",
                               f=33_333, n_nodes=100_000, n_rounds=64,
-                              n_sweeps=8, log_capacity=16, seed=7,
-                              sweep_chunk=2, **ADV),
+                              n_sweeps=8, log_capacity=16, seed=7, **ADV),
     # 4. Multi-decree Paxos 10k acceptors x 10k slots.
     "paxos-10kx10k": Config(protocol="paxos", n_nodes=10_000, n_rounds=16,
                             n_sweeps=1, log_capacity=10_000, seed=4, **ADV),

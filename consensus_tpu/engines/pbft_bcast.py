@@ -8,13 +8,19 @@ Under §6b, faults drop a sender's round broadcast atomically, so a
 receiver's prepare/commit tally is a pure multiset count over the slot's
 sender values, computable in O(N·S·log N):
 
-  * one `lax.sort` per slot over the sender values, carrying the two
-    per-partition-side validity flags as payload;
-  * inclusive→exclusive cumulative sums of each flag over the sorted
-    order (partitions are side-separable, §2);
-  * per receiver, `searchsorted` left/right brackets its own value's
-    run; the cumsum difference of its side's flag is the exact count —
-    no sentinel values, so arbitrary 32-bit payloads are safe.
+  * one `lax.sort` per slot over the sender values, carrying an index
+    payload (the permutation) plus every per-node flag the tallies
+    need, bit-packed into one i32 payload (partitions are
+    side-separable, §2 — the side flags ride along too);
+  * equal-value run boundaries in sorted order by elementwise compare;
+    each value's count of valid same-value senders by gather-free
+    segmented scans (forward segmented sum, then reverse segmented max
+    to broadcast each run's total) — no sentinel values, so arbitrary
+    32-bit payloads are safe;
+  * both phases' tallies chain elementwise in sorted order and ONE
+    unsort (a second payload sort) returns the results (arbitrary-index
+    gathers run on the serial gather unit, ~15 ms per [16, 100k] pass
+    on v5 lite, so the design uses none; see _SortedTally).
 
 Protocol phases, state, and tie-breaks are §6's verbatim; only fault
 granularity changes (SPEC §6b: per-sender drops, unchanged partitions,
@@ -42,39 +48,77 @@ from .pbft import PbftState, pbft_init
 I32_MAX = jnp.iinfo(jnp.int32).max
 
 
-class _SortedCounter:
-    """Exact multiset counter: count_b[s, j] = |{i : valid_b[s, i] ∧
-    vals[s, i] == query[s, j]}| for arbitrary i32 values (validity rides
-    a permutation; nothing is masked to a sentinel).
+class _SortedTally:
+    """Exact multiset counter, entirely in sorted space: count[s, j] =
+    |{i : valid[s, i] ∧ vals[s, i] == vals[s, j]}| for arbitrary i32
+    values (validity rides the permutation; nothing is masked to a
+    sentinel).
 
-    The O(N·S·log N) sort and both searchsorted brackets depend only on
-    (vals, query), so they run ONCE per round and serve both the P4 and
-    P5 tallies — only the per-phase validity gather/cumsum differs.
+    The round is sort-bound at N=100k, so the design minimizes
+    sort-class passes AND arbitrary-index gathers: ONE payload sort up
+    front carries the per-node flags (a searchsorted — even with the
+    sort-based lowering — would be a full extra sort per side, and the
+    default binary-search lowering is a 17-step sequential gather loop,
+    ~345 ms/call on v5 lite at [16, 100k], whose batched form faults
+    the TPU worker); counts are gather-free segmented scans over
+    equal-value runs (see count()); and ONE unsort (a second payload
+    sort keyed on the permutation) returns all phases' results
+    together. Callers unpack their flags from the sorted payload,
+    combine counts elementwise there (P4 → P5 chain included), and
+    unsort once.
     """
 
-    def __init__(self, vals_sn, query_sn):
+    def __init__(self, vals_sn, bits_sn, extra_sn=None):
+        """``bits_sn``: per-(slot, node) i32 bitmask of every flag the
+        tally phases need, riding the sort as ONE payload (a [16, 100k]
+        arbitrary-index gather costs ~15 ms on v5 lite — 9 of them were
+        90% of the round — while an extra sort payload is ~free).
+        ``extra_sn``: optional i32 payload (equivocating-byz support)."""
         S, N = vals_sn.shape
         iota = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (S, N))
-        self.sv, self.perm = jax.lax.sort((vals_sn, iota), dimension=1,
-                                          num_keys=1)
+        ops = (vals_sn, iota, bits_sn) + \
+            (() if extra_sn is None else (extra_sn,))
+        srt = jax.lax.sort(ops, dimension=1, num_keys=1)
+        self.sv, self.perm, self.bits = srt[0], srt[1], srt[2]
+        self.extra = srt[3] if extra_sn is not None else None
+        brk = self.sv[:, 1:] != self.sv[:, :-1]
+        self.newrun = jnp.concatenate([jnp.ones((S, 1), bool), brk], axis=1)
+        self.endrun = jnp.concatenate([brk, jnp.ones((S, 1), bool)], axis=1)
 
-        def one_slot(sorted_v, q):
-            # method="sort" is the only TPU-viable lowering at N=100k:
-            # the default binary-search method is a 17-step sequential
-            # gather loop (~345 ms/call measured on v5 lite at [16,100k]);
-            # the sort-based lowering rides the fast batched sort unit
-            # (<1 ms). Same results, bit-for-bit.
-            return (jnp.searchsorted(sorted_v, q, side="left", method="sort"),
-                    jnp.searchsorted(sorted_v, q, side="right", method="sort"))
+    def bit(self, k):
+        """Unpack flag k of the packed payload, sorted order [S, N]."""
+        return ((self.bits >> k) & 1).astype(bool)
 
-        self.lo, self.hi = jax.vmap(one_slot)(self.sv, query_sn)
+    def count(self, valid_sn_sorted):
+        """Per-position count of valid entries in its equal-value run —
+        gather-free: a forward segmented sum (reset at run starts) puts
+        the run total at each run's END; within a run the prefix is
+        nondecreasing, so a reverse segmented MAX (reset at run ends)
+        propagates that total back to every member."""
+        f = valid_sn_sorted.astype(jnp.int32)
 
-    def count(self, valid_sn):
-        f = jnp.take_along_axis(valid_sn.astype(jnp.int32), self.perm, axis=1)
-        zero = jnp.zeros(f.shape[:-1] + (1,), jnp.int32)
-        ex = jnp.concatenate([zero, jnp.cumsum(f, axis=1)], axis=1)  # [S,N+1]
-        return (jnp.take_along_axis(ex, self.hi, axis=1)
-                - jnp.take_along_axis(ex, self.lo, axis=1))
+        def seg_sum(a, b):
+            s1, _ = a
+            s2, b2 = b
+            return (jnp.where(b2, s2, s1 + s2), a[1] | b2)
+
+        s, _ = jax.lax.associative_scan(seg_sum, (f, self.newrun), axis=1)
+
+        def seg_max(a, b):
+            m1, _ = a
+            m2, b2 = b
+            return (jnp.where(b2, m2, jnp.maximum(m1, m2)), a[1] | b2)
+
+        tot, _ = jax.lax.associative_scan(seg_max, (s, self.endrun),
+                                          axis=1, reverse=True)
+        return tot
+
+    def unsort(self, packed_sn):
+        """Sorted-order [S, N] i32 payload → original [N, S] order via
+        one payload sort keyed on the permutation."""
+        _, out = jax.lax.sort((self.perm, packed_sn), dimension=1,
+                              num_keys=1)
+        return out.T
 
 
 def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
@@ -89,11 +133,20 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
     sarange = jnp.arange(S, dtype=jnp.int32)
 
     # ---- SPEC §6b adversary: per-sender broadcast drops + §2 partition.
+    # partition_cutoff == 0 is a static config fact: the partition can
+    # never activate, every side_ok() is identically true, and the two
+    # sides' tallies/sorts/minima are equal — so the no_part branches
+    # below compute one of everything instead of two (the 4 per-round
+    # multiset counts are ~60% of the round at N=100k). Bit-identical:
+    # streams are counter-based, so not drawing `side` changes nothing
+    # else. The general path is untouched.
+    no_part = cfg.partition_cutoff == 0
     bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
-    part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
-                   < _lt(cfg.partition_cutoff))
-    side = (_draw(seed, rng.STREAM_PARTITION, ur, 1, uidx)
-            & jnp.uint32(1)).astype(jnp.int32)                   # [N]
+    if not no_part:
+        part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
+                       < _lt(cfg.partition_cutoff))
+        side = (_draw(seed, rng.STREAM_PARTITION, ur, 1, uidx)
+                & jnp.uint32(1)).astype(jnp.int32)               # [N]
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
     honest = idx < (N - cfg.n_byzantine)
     byz = ~honest
@@ -127,12 +180,18 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
     # One batched [2, N] sort for both partition sides: 1-D sorts hit a
     # serial TPU path (~64 ms each at N=100k) while batched sorts are
     # near-free; row-wise results are identical.
-    cols = jnp.stack([jnp.where(sender_v & side_ok(0), view, -1),
-                      jnp.where(sender_v & side_ok(1), view, -1)])
-    t = jnp.sort(cols, axis=1)                                   # ascending
-    a1 = t[:, N - K][side]                                       # [N]
-    a2 = (t[:, N - K + 1] if K >= 2
-          else jnp.full((2,), I32_MAX, jnp.int32))[side]
+    if no_part:
+        t = jnp.sort(jnp.where(sender_v, view, -1)[None, :], axis=1)
+        a1 = jnp.broadcast_to(t[0, N - K], (N,))                 # [N]
+        a2 = (jnp.broadcast_to(t[0, N - K + 1], (N,)) if K >= 2
+              else jnp.full((N,), I32_MAX, jnp.int32))
+    else:
+        cols = jnp.stack([jnp.where(sender_v & side_ok(0), view, -1),
+                          jnp.where(sender_v & side_ok(1), view, -1)])
+        t = jnp.sort(cols, axis=1)                               # ascending
+        a1 = t[:, N - K][side]                                   # [N]
+        a2 = (t[:, N - K + 1] if K >= 2
+              else jnp.full((2,), I32_MAX, jnp.int32))[side]
     in_set = sender_v                                            # self side ok
     vth = jnp.where(in_set, a1, jnp.clip(view, a1, a2))
     catch = vth > view
@@ -157,8 +216,11 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
     msg_val = jnp.where(pp_seen, pp_val, fresh_val)
 
     prim = view % N
-    prim_del = (prim == idx) | (bcast[prim]
-                                & (~part_active | (side[prim] == side)))
+    if no_part:
+        prim_del = (prim == idx) | bcast[prim]
+    else:
+        prim_del = (prim == idx) | (bcast[prim]
+                                    & (~part_active | (side[prim] == side)))
     prim_ok = prim_del & (view[prim] == view)
     pm_b = ppb[prim]
     pm_val = msg_val[prim]
@@ -179,52 +241,78 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
     pp_val = jnp.where(accept, pm_val, pp_val)
     pp_seen = pp_seen | accept
 
-    # Shared [S, N] views of the tally inputs; one sort serves P4 + P5.
-    vals_sn = pp_val.T
-    counter = _SortedCounter(vals_sn, vals_sn)
-
+    # ---- P4 + P5 tallies, entirely in sorted space (one sort carrying
+    # every needed flag as a packed payload, one unsort — see
+    # _SortedTally). The P4 → P5 dependency (commit votes only count
+    # prepared nodes) chains elementwise in sorted order.
     if equiv:
         # Byz support is value-independent (SPEC §6b): one count per
         # side, minus the receiver's own stance (self never travels).
         eq_send = byz & bcast & stance
-        extra = jnp.stack([jnp.sum((eq_send & side_ok(0)).astype(jnp.int32)),
-                           jnp.sum((eq_send & side_ok(1)).astype(jnp.int32))
-                           ])[side]                              # [N]
+        if no_part:
+            extra = jnp.broadcast_to(jnp.sum(eq_send.astype(jnp.int32)),
+                                     (N,))
+        else:
+            extra = jnp.stack(
+                [jnp.sum((eq_send & side_ok(0)).astype(jnp.int32)),
+                 jnp.sum((eq_send & side_ok(1)).astype(jnp.int32))
+                 ])[side]                                        # [N]
         extra = extra - (eq_send).astype(jnp.int32)
-        extra = extra[:, None]
+        extra_sn = jnp.broadcast_to(extra[:, None], (N, S)).T
     else:
-        extra = jnp.zeros((N, 1), jnp.int32)
+        extra_sn = None
 
-    def counts_for(relevant_ns):
-        """Value-matched §6b count[j, s] incl. self (SPEC §6 P4/P5):
-        sorted-count of broadcasting senders + the self vote (which
-        never travels, so it counts regardless of bcast fate)."""
-        c0 = counter.count((honest & bcast & side_ok(0))[None, :]
-                           & relevant_ns.T)
-        c1 = counter.count((honest & bcast & side_ok(1))[None, :]
-                           & relevant_ns.T)
-        cnt = jnp.where((side == 0)[None, :], c0, c1).T           # [N, S]
-        self_adj = (honest[:, None] & relevant_ns
-                    & ~bcast[:, None]).astype(jnp.int32)
-        return cnt + self_adj + extra
+    def b32(x):
+        return x.astype(jnp.int32)
+
+    bits = (b32(pp_seen) | (b32(prepared) << 1) | (b32(committed) << 2)
+            | ((b32(honest) | (b32(bcast) << 1))[:, None] << 3))
+    if not no_part:
+        bits |= ((b32(side) | (b32(side_ok(0)) << 1)
+                  | (b32(side_ok(1)) << 2))[:, None] << 5)
+    tal = _SortedTally(pp_val.T, bits.T, extra_sn)
+    pp_seen_s, prepared_s, committed_s = tal.bit(0), tal.bit(1), tal.bit(2)
+    honest_s, bcast_s = tal.bit(3), tal.bit(4)
+    hb_s = honest_s & bcast_s
+    extra_s = jnp.int32(0) if tal.extra is None else tal.extra
+
+    def counts_for_s(relevant_s):
+        """Value-matched §6b count incl. self (SPEC §6 P4/P5), sorted
+        order: sorted-count of broadcasting senders + the self vote
+        (which never travels, so it counts regardless of bcast fate)."""
+        if no_part:
+            cnt = tal.count(hb_s & relevant_s)
+        else:
+            c0 = tal.count(hb_s & tal.bit(6) & relevant_s)
+            c1 = tal.count(hb_s & tal.bit(7) & relevant_s)
+            cnt = jnp.where(tal.bit(5), c1, c0)
+        self_adj = (honest_s & relevant_s & ~bcast_s).astype(jnp.int32)
+        return cnt + self_adj + extra_s
 
     # ---- P4 prepare tally.
-    pcount = counts_for(pp_seen)
-    prepared = prepared | (pp_seen & (pcount >= Q))
+    prepared2_s = prepared_s | (pp_seen_s & (counts_for_s(pp_seen_s) >= Q))
 
     # ---- P5 commit tally.
-    ccount = counts_for(prepared)
-    commit_now = prepared & (ccount >= Q) & ~committed
+    commit_now_s = (prepared2_s & (counts_for_s(prepared2_s) >= Q)
+                    & ~committed_s)
+
+    packed = tal.unsort(b32(prepared2_s) | (b32(commit_now_s) << 1))
+    prepared = (packed & 1).astype(bool)
+    commit_now = (packed >> 1).astype(bool)
     dval = jnp.where(commit_now, pp_val, dval)
     committed = committed | commit_now
 
     # ---- P6 decide gossip: lowest-id broadcasting decider per side.
     dec = honest[:, None] & bcast[:, None] & committed            # [N, S]
-    imin = []
-    for b in (0, 1):
-        src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
-        imin.append(jnp.min(src, axis=0))                         # [S]
-    imin = jnp.stack(imin)[side]                                  # [N, S]
+    if no_part:
+        src = jnp.where(dec, idx[:, None], N)
+        imin = jnp.broadcast_to(jnp.min(src, axis=0)[None, :], (N, S))
+    else:
+        imin = []
+        for b in (0, 1):
+            src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
+            imin.append(jnp.min(src, axis=0))                     # [S]
+        imin = jnp.stack(imin)[side]                              # [N, S]
     adopt = (imin < N) & ~committed
     dval = jnp.where(adopt, dval[jnp.clip(imin, 0, N - 1),
                                  sarange[None, :]], dval)

@@ -90,12 +90,22 @@ def raft_init(cfg: Config, seed) -> RaftState:
 from ..ops.adversary import delivery as _delivery  # SPEC §2 delivery mask
 
 
+def _pick1(mat, k):
+    """mat[i, k[i]] as a one-hot masked reduction. The obvious
+    ``take_along_axis(mat, k[:, None], 1)[:, 0]`` lowers to the serial
+    per-element gather unit (~10 ms per call at [800k, 128] on v5 lite
+    — it was half the capped-engine round); the masked reduce is one
+    vectorized fused pass (~2-4x faster, exact: one hot lane per row)."""
+    L = mat.shape[-1]
+    hot = jnp.arange(L, dtype=jnp.int32)[None, :] == k.astype(jnp.int32)[:, None]
+    return jnp.sum(jnp.where(hot, mat.astype(jnp.int32), 0), axis=1)
+
+
 def _last_term(log_term, log_len):
     """log_term[i, log_len[i]-1] or 0 for empty logs."""
     L = log_term.shape[-1]
     k = jnp.clip(log_len - 1, 0, L - 1)
-    v = jnp.take_along_axis(log_term, k[:, None], axis=1)[:, 0]
-    return jnp.where(log_len > 0, v, 0)
+    return jnp.where(log_len > 0, _pick1(log_term, k), 0)
 
 
 def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
@@ -235,11 +245,10 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     prev = s_next[ls, idx].astype(jnp.int32) - 1     # [N] (i32: u8 can't go -1)
     lrow_t = jnp.take(s_logt, ls, axis=0)            # [N, L] leader log rows
     lrow_v = jnp.take(s_logv, ls, axis=0)
-    kprev = jnp.clip(prev - 1, 0, L - 1)[:, None]
-    prev_term_l = jnp.where(prev > 0,
-                            jnp.take_along_axis(lrow_t, kprev, axis=1)[:, 0], 0)
+    kprev = jnp.clip(prev - 1, 0, L - 1)
+    prev_term_l = jnp.where(prev > 0, _pick1(lrow_t, kprev), 0)
     own_at_prev = jnp.where((prev > 0) & (prev <= log_len),
-                            jnp.take_along_axis(log_term, kprev, axis=1)[:, 0], 0)
+                            _pick1(log_term, kprev), 0)
     ok = (prev == 0) | ((prev <= log_len) & (own_at_prev == prev_term_l))
     apply_ = has_l & ok
 
@@ -295,8 +304,8 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
         lo = jnp.where(ok, mid, lo)
         hi = jnp.where(ok, hi, mid)
     med = lo
-    kmed = jnp.clip(med - 1, 0, L - 1)[:, None]
-    term_at_med = jnp.take_along_axis(log_term, kmed, axis=1)[:, 0]
+    kmed = jnp.clip(med - 1, 0, L - 1)
+    term_at_med = _pick1(log_term, kmed)
     adv = proc & (med > commit) & (med > 0) & (term_at_med == term)
     commit = jnp.where(adv, med, commit)
 

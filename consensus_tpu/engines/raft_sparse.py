@@ -42,7 +42,7 @@ from ..ops.adversary import delivery_edges as _edges
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from .raft import (NONE, ROLE_C, ROLE_F, ROLE_L, _draw_timeout, _last_term,
-                   _match_dtype)
+                   _match_dtype, _pick1)
 
 I32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -253,12 +253,10 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     prev = s_next[kstar, idx].astype(jnp.int32) - 1            # [N] (i32: u8 can't go -1)
     lrow_t = s_logt[kstar]                                     # [N, L]
     lrow_v = s_logv[kstar]
-    kprev = jnp.clip(prev - 1, 0, L - 1)[:, None]
-    prev_term_l = jnp.where(prev > 0,
-                            jnp.take_along_axis(lrow_t, kprev, axis=1)[:, 0], 0)
+    kprev = jnp.clip(prev - 1, 0, L - 1)
+    prev_term_l = jnp.where(prev > 0, _pick1(lrow_t, kprev), 0)
     own_at_prev = jnp.where((prev > 0) & (prev <= log_len),
-                            jnp.take_along_axis(log_term, kprev, axis=1)[:, 0],
-                            0)
+                            _pick1(log_term, kprev), 0)
     ok = (prev == 0) | ((prev <= log_len) & (own_at_prev == prev_term_l))
     apply_ = has_l & ok
 

@@ -32,6 +32,13 @@ CONFIGS = [
     ("f8-byz-equiv", _cfg(f=8, n_byzantine=8, byz_mode="equivocate",
                           n_rounds=40, seed=11)),
     ("f10-mid", _cfg(f=10, n_rounds=32, seed=13)),
+    # partition_rate=0 with drops/churn/equivocation live: exercises the
+    # kernel's static no-partition specialization (one-sided tallies,
+    # sorts, minima, byz extra) against the unspecialized oracle — the
+    # BASELINE pbft-100k-bcast benchmark shape is exactly this class.
+    ("f3-nopart-hostile", _cfg(f=3, drop_rate=0.2, partition_rate=0.0,
+                               churn_rate=0.05, n_byzantine=3,
+                               byz_mode="equivocate", n_rounds=64, seed=21)),
 ]
 
 
