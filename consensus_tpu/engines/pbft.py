@@ -35,6 +35,45 @@ class PbftState(NamedTuple):
     dval: jnp.ndarray       # [N, S] i32
 
 
+def _vth_select(w, f, vmax):
+    """(f+1)-th largest per column of ``w`` (ints in [-1, vmax]): the
+    largest v with |{i : w[i, j] >= v}| >= f+1, by fixed-depth binary
+    search on the value range — the full [N, N] column sort it replaces
+    was ~20% of the one-program f-sweep (same move as the raft commit
+    advance, docs/PERF.md). Works with a traced per-lane ``f``.
+
+    Searches t = v+1 in [0, vmax+2) so the midpoint floor-division
+    never stalls at lo = -1. Invariant: cnt_ge(lo) >= f+1 (lo = -1
+    counts all N > f), cnt_ge(hi) < f+1 (hi = vmax+1 counts none).
+    """
+    n_cols = w.shape[1]
+    w1 = w + 1
+    lo = jnp.zeros(n_cols, jnp.int32)
+    hi = jnp.full(n_cols, vmax + 2, jnp.int32)
+    for _ in range(int(vmax + 1).bit_length()):
+        mid = (lo + hi) // 2
+        cnt = jnp.sum((w1 >= mid[None, :]).astype(jnp.int32), axis=0)
+        ok = cnt >= f + 1
+        lo = jnp.where(ok, mid, lo)
+        hi = jnp.where(ok, hi, mid)
+    return lo - 1
+
+
+def _adopt_val(d_h, dec_b, imin, dval):
+    """Value at ``dval[imin[j, s], s]`` without the arbitrary-index 2D
+    gather (serial gather unit, 62% of the f-sweep program): the min-id
+    decider is unique per (receiver, slot), so an equality mask + max
+    reduction over the existing [N, N, S] broadcast shape is exact.
+    Positions with no decider (imin == N) return I32_MIN; callers mask
+    them via ``adopt``."""
+    N = d_h.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    win = (d_h[:, :, None] & dec_b[:, None, :]
+           & (idx[:, None, None] == imin[None, :, :]))
+    return jnp.max(jnp.where(win, dval[:, None, :],
+                             jnp.iinfo(jnp.int32).min), axis=0)
+
+
 def pbft_init(cfg: Config, seed) -> PbftState:
     N, S = cfg.n_nodes, cfg.log_capacity
     z = jnp.zeros(N, jnp.int32)
@@ -82,7 +121,7 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
     # ---- P1 view catch-up: (f+1)-th largest delivered honest view ∪ own.
     w = jnp.where(d_h, view[:, None], -1)                       # [i, j]
     w = jnp.where(jnp.eye(N, dtype=bool), view[None, :], w)     # include self
-    vth = jnp.sort(w, axis=0)[N - 1 - f, :]                     # (f+1)-th largest
+    vth = _vth_select(w, f, 2 * cfg.n_rounds + 2)               # (f+1)-th largest
     catch = vth > view
     view = jnp.where(catch, vth, view)
     timer = jnp.where(catch, 0, timer)
@@ -154,7 +193,7 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
     imin = jnp.min(jnp.where(d_h[:, :, None] & dec_b[:, None, :],
                              idx[:, None, None], N), axis=0)           # [j, s]
     adopt = (imin < N) & ~committed
-    dval = jnp.where(adopt, dval[jnp.clip(imin, 0, N - 1), sarange[None, :]], dval)
+    dval = jnp.where(adopt, _adopt_val(d_h, dec_b, imin, dval), dval)
     committed = committed | adopt
 
     # ---- P7 timer.

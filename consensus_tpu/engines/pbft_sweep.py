@@ -32,6 +32,7 @@ from ..core.config import Config
 from ..ops.adversary import churn as churn_draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import delivery as _delivery
+from .pbft import _adopt_val, _vth_select
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import bitcast_i32 as _i32
 from .pbft import PbftState
@@ -82,10 +83,10 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     # ---- P1 view catch-up: (f+1)-th largest delivered honest view ∪ own.
     w = jnp.where(d_h, view[:, None], -1)
     w = jnp.where(jnp.eye(N, dtype=bool), view[None, :], w)
-    # (f+1)-th largest with traced f: index N-1-f of the ascending sort.
-    # Padded senders contribute -1, which sorts low; f < n_real <= N keeps
-    # the index inside the real entries.
-    vth = jnp.take(jnp.sort(w, axis=0), N - 1 - f, axis=0)
+    # (f+1)-th largest with traced f, by value binary search (padded
+    # senders contribute -1, which never wins; f < n_real <= N keeps
+    # the statistic inside the real entries).
+    vth = _vth_select(w, f, 2 * cfg.n_rounds + 2)
     catch = vth > view
     view = jnp.where(catch, vth, view)
     timer = jnp.where(catch, 0, timer)
@@ -153,7 +154,7 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     imin = jnp.min(jnp.where(d_h[:, :, None] & dec_b[:, None, :],
                              idx[:, None, None], N), axis=0)
     adopt = (imin < N) & ~committed
-    dval = jnp.where(adopt, dval[jnp.clip(imin, 0, N - 1), sarange[None, :]], dval)
+    dval = jnp.where(adopt, _adopt_val(d_h, dec_b, imin, dval), dval)
     committed = committed | adopt
 
     # ---- P7 timer.
