@@ -90,15 +90,48 @@ def raft_init(cfg: Config, seed) -> RaftState:
 from ..ops.adversary import delivery as _delivery  # SPEC §2 delivery mask
 
 
+# Mask elements below which the helpers keep the plain gather: the
+# one-hot reduce pays O(rows*cols) vector work to avoid the serial
+# gather unit — a win at benchmark shapes, pure overhead at tiny ones
+# (where the gather is a handful of elements; measured raft-5node
+# readings 5.5-7.6M steps/s are dispatch-bound variance either way,
+# docs/PERF.md). Both paths are value-identical; the reduce path is
+# oracle-differential-tested by the large-N configs in
+# tests/test_raft_differential.py / test_raft_sparse.py.
+_SMALL_PICK = 4096
+
+
 def _pick1(mat, k):
     """mat[i, k[i]] as a one-hot masked reduction. The obvious
     ``take_along_axis(mat, k[:, None], 1)[:, 0]`` lowers to the serial
     per-element gather unit (~10 ms per call at [800k, 128] on v5 lite
     — it was half the capped-engine round); the masked reduce is one
     vectorized fused pass (~2-4x faster, exact: one hot lane per row)."""
+    k = k.astype(jnp.int32)
+    if mat.shape[0] * mat.shape[-1] <= _SMALL_PICK:
+        return jnp.take_along_axis(mat, k[:, None],
+                                   axis=1)[:, 0].astype(jnp.int32)
     L = mat.shape[-1]
-    hot = jnp.arange(L, dtype=jnp.int32)[None, :] == k.astype(jnp.int32)[:, None]
+    hot = jnp.arange(L, dtype=jnp.int32)[None, :] == k[:, None]
     return jnp.sum(jnp.where(hot, mat.astype(jnp.int32), 0), axis=1)
+
+
+def _pick_row(mat, rsel):
+    """mat[rsel[j], j] for [R, N] ``mat`` (or an [R] vector broadcast to
+    columns) — same serial-gather avoidance as :func:`_pick1`, reducing
+    over the row axis. Out-of-range ``rsel`` yields 0 on the reduce
+    path; every caller clips/bounds ``rsel``, so both paths agree."""
+    rsel = rsel.astype(jnp.int32)
+    R = mat.shape[0]
+    n = rsel.shape[0]
+    if mat.ndim == 1:
+        if R * n <= _SMALL_PICK:
+            return mat[rsel].astype(jnp.int32)
+        mat = jnp.broadcast_to(mat[:, None], (R, n))
+    elif R * mat.shape[1] <= _SMALL_PICK:
+        return mat[rsel, jnp.arange(n, dtype=jnp.int32)].astype(jnp.int32)
+    hot = jnp.arange(R, dtype=jnp.int32)[:, None] == rsel[None, :]
+    return jnp.sum(jnp.where(hot, mat.astype(jnp.int32), 0), axis=0)
 
 
 def _last_term(log_term, log_len):
@@ -181,7 +214,7 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
         & (req_lidx[:, None] >= log_len[None, :]))
     elig = was_cand[:, None] & deliver & (req_term[:, None] == term[None, :]) & up_to_date
     vf_safe = jnp.clip(voted_for, 0, N - 1)
-    vf_elig = (voted_for >= 0) & elig[vf_safe, idx]
+    vf_elig = (voted_for >= 0) & (_pick_row(elig, vf_safe) > 0)
     first_elig = jnp.min(jnp.where(elig, idx[:, None], N), axis=0)
     grant = jnp.where(
         vf_elig, voted_for,
@@ -242,7 +275,7 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     reset |= has_l
     role = jnp.where(has_l & (role == ROLE_C), ROLE_F, role)
 
-    prev = s_next[ls, idx].astype(jnp.int32) - 1     # [N] (i32: u8 can't go -1)
+    prev = _pick_row(s_next, ls) - 1                 # [N] (i32: u8 can't go -1)
     lrow_t = jnp.take(s_logt, ls, axis=0)            # [N, L] leader log rows
     lrow_v = jnp.take(s_logv, ls, axis=0)
     kprev = jnp.clip(prev - 1, 0, L - 1)
@@ -252,14 +285,16 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     ok = (prev == 0) | ((prev <= log_len) & (own_at_prev == prev_term_l))
     apply_ = has_l & ok
 
-    l_len = s_len[ls]
+    l_len = _pick_row(s_len, ls)
     karange = jnp.arange(L, dtype=jnp.int32)[None, :]
     copy_mask = apply_[:, None] & (karange >= prev[:, None]) & (karange < l_len[:, None])
     log_term = jnp.where(copy_mask, lrow_t, log_term)
     log_val = jnp.where(copy_mask, lrow_v, log_val)
     log_len = jnp.where(apply_, l_len, log_len)
-    commit = jnp.where(apply_, jnp.maximum(commit, jnp.minimum(s_commit[ls], log_len)),
-                       commit)
+    commit = jnp.where(
+        apply_,
+        jnp.maximum(commit, jnp.minimum(_pick_row(s_commit, ls), log_len)),
+        commit)
     ack_to = jnp.where(has_l, ls, NONE)
     ack_ok = apply_
     ack_match = jnp.where(apply_, l_len, 0)

@@ -42,7 +42,7 @@ from ..ops.adversary import delivery_edges as _edges
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from .raft import (NONE, ROLE_C, ROLE_F, ROLE_L, _draw_timeout, _last_term,
-                   _match_dtype, _pick1)
+                   _match_dtype, _pick1, _pick_row)
 
 I32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -250,7 +250,7 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     reset |= has_l
     role = jnp.where(has_l & (role == ROLE_C), ROLE_F, role)
 
-    prev = s_next[kstar, idx].astype(jnp.int32) - 1            # [N] (i32: u8 can't go -1)
+    prev = _pick_row(s_next, kstar) - 1                        # [N] (i32: u8 can't go -1)
     lrow_t = s_logt[kstar]                                     # [N, L]
     lrow_v = s_logv[kstar]
     kprev = jnp.clip(prev - 1, 0, L - 1)
@@ -260,14 +260,15 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     ok = (prev == 0) | ((prev <= log_len) & (own_at_prev == prev_term_l))
     apply_ = has_l & ok
 
-    l_len = s_len[kstar]
+    l_len = _pick_row(s_len, kstar)
     copy_mask = apply_[:, None] & (karange >= prev[:, None]) \
         & (karange < l_len[:, None])
     log_term = jnp.where(copy_mask, lrow_t, log_term)
     log_val = jnp.where(copy_mask, lrow_v, log_val)
     log_len = jnp.where(apply_, l_len, log_len)
     commit = jnp.where(
-        apply_, jnp.maximum(commit, jnp.minimum(s_commit[kstar], log_len)),
+        apply_,
+        jnp.maximum(commit, jnp.minimum(_pick_row(s_commit, kstar), log_len)),
         commit)
     ack_slot = jnp.where(has_l, kstar, A)                      # A ⇒ no ack
     ack_ok = apply_

@@ -33,6 +33,13 @@ ADVERSARIAL = [
                         n_rounds=96, drop_rate=0.2, seed=15, n_sweeps=2),
     dataclasses.replace(CLEAN, log_capacity=65600, max_entries=32,
                         n_rounds=24, n_sweeps=1, seed=17),
+    # N=96 > 64 puts _pick_row's [N, N] masks above the _SMALL_PICK
+    # gate: the one-hot-reduce path (the one every benchmark shape
+    # takes) gets oracle-differential coverage, not just the small-N
+    # gather path.
+    dataclasses.replace(CLEAN, n_nodes=96, n_rounds=96, log_capacity=64,
+                        max_entries=48, drop_rate=0.2, churn_rate=0.05,
+                        seed=18, n_sweeps=2),
 ]
 
 

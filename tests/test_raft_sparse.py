@@ -31,6 +31,11 @@ CONFIGS = [
     ("hostile", _cfg(max_active=4, n_nodes=9, n_rounds=128, drop_rate=0.3,
                      partition_rate=0.2, churn_rate=0.1, seed=7)),
     ("bigger", _cfg(max_active=4, n_nodes=33, n_rounds=64, seed=5)),
+    # A*N = 8*640 > _SMALL_PICK: drives _pick_row's one-hot-reduce path
+    # (what raft-100k runs) through the oracle differential, not just
+    # the small-shape gather fallback.
+    ("reduce-path", _cfg(max_active=8, n_nodes=640, n_rounds=48,
+                         n_sweeps=1, seed=29)),
 ]
 
 
