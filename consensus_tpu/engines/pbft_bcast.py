@@ -303,19 +303,29 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
     committed = committed | commit_now
 
     # ---- P6 decide gossip: lowest-id broadcasting decider per side.
+    # The decider — hence the adopted value — varies only per
+    # (partition side, slot): gather the ≤2 candidate rows (O(S)
+    # elements) and select per receiver, NEVER a [N, S] arbitrary-index
+    # gather of those same values (that gather ran on the serial unit
+    # and was 66% of the 8-sweep 100k program; docs/PERF.md).
     dec = honest[:, None] & bcast[:, None] & committed            # [N, S]
     if no_part:
         src = jnp.where(dec, idx[:, None], N)
-        imin = jnp.broadcast_to(jnp.min(src, axis=0)[None, :], (N, S))
+        imin_rows = jnp.min(src, axis=0)[None, :]                 # [1, S]
+        imin = jnp.broadcast_to(imin_rows, (N, S))
     else:
-        imin = []
+        rows = []
         for b in (0, 1):
             src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
-            imin.append(jnp.min(src, axis=0))                     # [S]
-        imin = jnp.stack(imin)[side]                              # [N, S]
+            rows.append(jnp.min(src, axis=0))                     # [S]
+        imin_rows = jnp.stack(rows)                               # [2, S]
+        imin = imin_rows[side]                                    # [N, S]
     adopt = (imin < N) & ~committed
-    dval = jnp.where(adopt, dval[jnp.clip(imin, 0, N - 1),
-                                 sarange[None, :]], dval)
+    val_rows = dval[jnp.clip(imin_rows, 0, N - 1),
+                    sarange[None, :]]                             # [1|2, S]
+    vfull = (jnp.broadcast_to(val_rows, (N, S)) if no_part
+             else val_rows[side])
+    dval = jnp.where(adopt, vfull, dval)
     committed = committed | adopt
 
     # ---- P7 timer.
