@@ -117,6 +117,20 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
                              "is a subset of the population, SPEC §3b)")
         if self.sweep_chunk < 0:
             raise ValueError("sweep_chunk must be >= 0 (0 = one program)")
+        if self.protocol == "dpos":
+            # Candidates are a subset of the validator population and
+            # producers a subset of candidates — the C++ oracle rejects
+            # anything else (cpp/oracle.cpp DposSim validation); mirror
+            # it here so the JAX engine can't silently run a config the
+            # oracle refuses.
+            if not (1 <= self.n_producers <= self.n_candidates
+                    <= self.n_nodes):
+                raise ValueError(
+                    "dpos requires 1 <= n_producers <= n_candidates "
+                    f"<= n_nodes, got K={self.n_producers} "
+                    f"C={self.n_candidates} V={self.n_nodes}")
+            if self.epoch_len < 1:
+                raise ValueError("epoch_len must be >= 1")
 
     # Integer cutoffs — THE values both engines compare draws against.
     @property

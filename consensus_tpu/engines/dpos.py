@@ -17,13 +17,13 @@ import numpy as np
 
 from ..core import rng
 from ..core.config import Config
-from .raft import _draw, _lt
+from .raft import _draw, _lt, _store_dtype
 
 
 class DposState(NamedTuple):
     seed: jnp.ndarray       # [] uint32
-    chain_r: jnp.ndarray    # [V, L] i32 — block round
-    chain_p: jnp.ndarray    # [V, L] i32 — block producer
+    chain_r: jnp.ndarray    # [V, L] _store_dtype(n_rounds-1) — block round
+    chain_p: jnp.ndarray    # [V, L] _store_dtype(n_candidates-1) — producer
     chain_len: jnp.ndarray  # [V] i32
 
 
@@ -78,8 +78,9 @@ def dpos_round(cfg: Config, producers, st: DposState, r) -> DposState:
 
     slot_hot = (jnp.arange(L, dtype=jnp.int32)[None, :] == st.chain_len[:, None]) \
         & append[:, None]
-    chain_r = jnp.where(slot_hot, jnp.asarray(r, jnp.int32), st.chain_r)
-    chain_p = jnp.where(slot_hot, p, st.chain_p)
+    chain_r = jnp.where(slot_hot, jnp.asarray(r, st.chain_r.dtype),
+                        st.chain_r)
+    chain_p = jnp.where(slot_hot, p.astype(st.chain_p.dtype), st.chain_p)
     chain_len = st.chain_len + append.astype(jnp.int32)
     return DposState(seed, chain_r, chain_p, chain_len)
 
@@ -89,9 +90,13 @@ def dpos_make_carry(cfg: Config, seed):
     computed once from the seed and rides the scan carry unchanged."""
     _, producers, _ = dpos_schedule(cfg, seed)
     V, L = cfg.n_nodes, cfg.log_capacity
+    # chain_p holds PRODUCER ids — drawn from the top-K of the
+    # n_candidates tally (dpos_schedule), so the tight bound is
+    # n_candidates-1 (<= n_nodes-1, Config enforces C <= V): the 100k
+    # benchmark has C=1024 → u16 where a V-based bound would force i32.
     st0 = DposState(jnp.asarray(seed, jnp.uint32),
-                    jnp.zeros((V, L), jnp.int32),
-                    jnp.zeros((V, L), jnp.int32),
+                    jnp.zeros((V, L), _store_dtype(cfg.n_rounds - 1)),
+                    jnp.zeros((V, L), _store_dtype(cfg.n_candidates - 1)),
                     jnp.zeros(V, jnp.int32))
     return producers, st0
 
@@ -103,7 +108,8 @@ def dpos_round_carry(cfg: Config, carry, r):
 
 def _dpos_extract(carry) -> dict:
     _, st = carry
-    return {"chain_r": st.chain_r, "chain_p": st.chain_p,
+    return {"chain_r": st.chain_r.astype(jnp.int32),
+            "chain_p": st.chain_p.astype(jnp.int32),
             "chain_len": st.chain_len}
 
 

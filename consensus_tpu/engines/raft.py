@@ -57,17 +57,21 @@ def _draw_timeout(seed, t_min, t_max, term, idx):
     return jnp.int32(t_min) + (d % jnp.uint32(t_max - t_min)).astype(jnp.int32)
 
 
-def _match_dtype(L: int):
-    """Storage dtype for match/next replication state. Values are bounded
-    by L+1, so u8 holds them whenever L <= 254 (u16 up to 65534) — the
-    [N, N] (dense) / [A, N] (capped) match arrays are re-read by every
-    commit-advance binary-search iteration, and the round kernel is
-    HBM-bound (docs/PERF.md "next levers"), so a narrower dtype is a
-    direct bandwidth win. Same integer values at any width: decided logs
-    are bit-identical (differential suite) and the oracle keeps u32."""
-    if L <= 254:
+def _store_dtype(vmax: int):
+    """Narrowest unsigned storage holding values in [0, vmax]. The round
+    kernels are HBM-bound (docs/PERF.md), so for state re-read every
+    round a narrower dtype is a direct bandwidth win. Same integer
+    values at any width: decided logs are bit-identical (differential
+    suites) and the oracle keeps u32; extract boundaries cast back."""
+    if vmax <= 0xFF:
         return jnp.uint8
-    return jnp.uint16 if L <= 65534 else jnp.int32
+    return jnp.uint16 if vmax <= 0xFFFF else jnp.int32
+
+
+def _match_dtype(L: int):
+    """Storage dtype for match/next replication state: values are
+    bounded by L+1 (next_idx reaches exactly L+1 at a full log)."""
+    return _store_dtype(L + 1)
 
 
 def raft_init(cfg: Config, seed) -> RaftState:

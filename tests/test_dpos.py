@@ -17,7 +17,22 @@ CFGS = [
     dataclasses.replace(BASE, drop_rate=0.3, churn_rate=0.1, seed=1),
     dataclasses.replace(BASE, n_nodes=200, n_candidates=32, n_producers=21,
                         drop_rate=0.2, partition_rate=0.1, seed=2),
+    # Crosses the u8→u16 storage boundary on BOTH chain fields
+    # (producer ids up to 299, round ids up to 299) — pins the
+    # candidate-bounded chain_p dtype against the oracle.
+    dataclasses.replace(BASE, n_nodes=300, n_candidates=300,
+                        n_producers=21, n_rounds=300, drop_rate=0.1,
+                        seed=3),
 ]
+
+
+def test_dpos_config_rejects_candidates_exceeding_nodes():
+    # Candidates are a subset of validators — the oracle rejects
+    # C > V (cpp/oracle.cpp); Config must too, not run it one-sided.
+    with pytest.raises(ValueError, match="n_candidates"):
+        dataclasses.replace(BASE, n_nodes=100, n_candidates=600)
+    with pytest.raises(ValueError, match="n_candidates"):
+        dataclasses.replace(BASE, n_producers=40, n_candidates=16)
 
 
 @pytest.mark.parametrize("cfg", CFGS)
