@@ -36,6 +36,10 @@ CONFIGS = [
     # the small-shape gather fallback.
     ("reduce-path", _cfg(max_active=8, n_nodes=640, n_rounds=48,
                          n_sweeps=1, seed=29)),
+    # A=20 > 16: _rows_from_small's row-gather fallback (the select
+    # chain is only used at small static A) gets differential coverage.
+    ("wide-cap", _cfg(max_active=20, n_nodes=100, n_rounds=48,
+                      n_sweeps=1, seed=37)),
 ]
 
 
