@@ -194,16 +194,28 @@ def pbft_fsweep_timed(cfg: Config, fs, repeats: int = 1):
     """
     import time
 
+    from ..network.runner import _sync_elem
+
+    def sync(st):
+        # Timing policy matches time_tpu (benchmarks/run_benchmarks.py):
+        # the timed window covers device work via the shared jitted
+        # O(1)-byte completion witness (runner._sync_elem — dispatch is
+        # async and block_until_ready lies on the tunnel backend); the
+        # ~8 MB result extraction happens once, after timing.
+        np.asarray(_sync_elem(st.view))
+
     t0 = time.perf_counter()
-    out = pbft_fsweep_run(cfg, fs)
+    stF = _fsweep_device(cfg, fs)
+    sync(stF)  # un-synced warmup would drain inside the first window
     compile_s = time.perf_counter() - t0
     best = float("inf")
     for _ in range(max(1, repeats)):
         t0 = time.perf_counter()
-        out = pbft_fsweep_run(cfg, fs)
+        stF = _fsweep_device(cfg, fs)
+        sync(stF)
         best = min(best, time.perf_counter() - t0)
     real_steps = sum(3 * int(f) + 1 for f in fs) * cfg.n_rounds
-    return out, compile_s, best, real_steps
+    return _fsweep_slice(stF, fs), compile_s, best, real_steps
 
 
 def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:
@@ -213,6 +225,12 @@ def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:
     arrays sliced back to that element's real 3f+1 nodes — identical
     layout to engines.pbft.pbft_run's per-sweep output.
     """
+    return _fsweep_slice(_fsweep_device(cfg, fs), fs)
+
+
+def _fsweep_device(cfg: Config, fs):
+    """Run the one-program ladder; return the padded final state ON
+    DEVICE (callers extract or sync as appropriate)."""
     import dataclasses
 
     fs = [int(f) for f in fs]
@@ -222,8 +240,11 @@ def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:
     seeds = ((np.uint64(cfg.seed) + np.arange(len(fs), dtype=np.uint64))
              & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     n_reals = jnp.asarray([3 * f + 1 for f in fs], jnp.int32)
-    stF = _fsweep_jit(cfg_pad, jnp.asarray(seeds), n_reals,
-                      jnp.asarray(fs, jnp.int32))
+    return _fsweep_jit(cfg_pad, jnp.asarray(seeds), n_reals,
+                       jnp.asarray(fs, jnp.int32))
+
+
+def _fsweep_slice(stF, fs) -> list[dict]:
     # Pull each padded array ONCE and slice on the host: per-rung device
     # slicing issued 3 tiny transfers per rung — ~2·|fs| tunnel
     # round-trips that dominated the measured wall at |fs|=128 (~26 s
@@ -233,7 +254,7 @@ def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:
     view = np.asarray(stF.view)
     out = []
     for k, f in enumerate(fs):
-        n = 3 * f + 1
+        n = 3 * int(f) + 1
         out.append({
             "committed": committed[k, :n],
             "dval": dval[k, :n],
