@@ -138,7 +138,9 @@ def bench_pbft_fsweep(fs, repeats: int = 3) -> dict:
     not simulated work, so they may not inflate steps/sec. Compile time is
     reported separately (it is the cost the padding design amortizes).
     """
-    from consensus_tpu.engines.pbft_sweep import pbft_fsweep_timed
+    from consensus_tpu.core import serialize
+    from consensus_tpu.engines.pbft_sweep import (fsweep_payload,
+                                                  pbft_fsweep_timed)
 
     f_max = max(fs)
     cfg = Config(protocol="pbft", f=f_max, n_nodes=3 * f_max + 1, n_rounds=32,
@@ -146,13 +148,18 @@ def bench_pbft_fsweep(fs, repeats: int = 3) -> dict:
     out, compile_s, best, real_steps = pbft_fsweep_timed(cfg, fs,
                                                          repeats=repeats)
     assert any(o["committed"].any() for o in out), "f-sweep committed nothing"
+    # Same digest the CLI's --f-sweep reports — one shared payload
+    # definition (engines.pbft_sweep.fsweep_payload), so every
+    # RESULTS.json row carries a comparable equivalence handle.
+    payload = fsweep_payload(out)
 
     padded_steps = len(fs) * (3 * f_max + 1) * cfg.n_rounds
     return {"engine": "tpu", "fs": [int(f) for f in fs],
             "n_rounds": cfg.n_rounds, "log_capacity": cfg.log_capacity,
             "compile_s_one_program": compile_s,
             "steps": real_steps, "padded_steps": padded_steps,
-            "wall_s": best, "steps_per_sec": real_steps / best}
+            "wall_s": best, "steps_per_sec": real_steps / best,
+            "digest": serialize.digest(payload)}
 
 
 def bench_pbft_oracle_ladder(fs) -> list[dict]:

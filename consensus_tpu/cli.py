@@ -113,16 +113,11 @@ def _parse_fsweep(spec: str) -> list[int]:
 def _run_fsweep(cfg, args, platform_tag: str) -> int:
     """Run the padded single-program PBFT f-sweep and report one JSON line."""
     from .core import serialize
-    from .engines.pbft_sweep import pbft_fsweep_timed
+    from .engines.pbft_sweep import fsweep_payload, pbft_fsweep_timed
 
     fs = args.parsed_fs
     out, compile_s, wall, steps = pbft_fsweep_timed(cfg, fs)
-
-    payload = b""
-    for o in out:
-        c, s, v = serialize.pack_sparse(
-            o["committed"][None].astype(bool), o["dval"][None])
-        payload += serialize.serialize_decided("pbft", c, s, v)
+    payload = fsweep_payload(out)
     if args.out:
         with open(args.out, "wb") as fp:
             fp.write(payload)

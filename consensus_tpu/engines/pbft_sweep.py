@@ -218,6 +218,21 @@ def pbft_fsweep_timed(cfg: Config, fs, repeats: int = 1):
     return _fsweep_slice(stF, fs), compile_s, best, real_steps
 
 
+def fsweep_payload(out) -> bytes:
+    """Concatenated per-rung canonical decided payloads — THE equivalence
+    handle for a ladder run (byte-equal to running each f alone). One
+    definition shared by the CLI's --f-sweep report and the benchmark
+    suite so their digests cannot drift."""
+    from ..core import serialize
+
+    payload = b""
+    for o in out:
+        c, s, v = serialize.pack_sparse(
+            o["committed"][None].astype(bool), o["dval"][None])
+        payload += serialize.serialize_decided("pbft", c, s, v)
+    return payload
+
+
 def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:
     """Run sweep element k with f = fs[k], seed = cfg.seed + k, all in one
     compiled program. ``cfg.f`` is ignored; ``cfg.n_nodes`` may be 0 (it
