@@ -13,10 +13,11 @@ sender values, computable in O(N·S·log N):
     need, bit-packed into one i32 payload (partitions are
     side-separable, §2 — the side flags ride along too);
   * equal-value run boundaries in sorted order by elementwise compare;
-    each value's count of valid same-value senders by gather-free
-    segmented scans (forward segmented sum, then reverse segmented max
-    to broadcast each run's total) — no sentinel values, so arbitrary
-    32-bit payloads are safe;
+    each value's count of valid same-value senders gather-free from the
+    plain monotone cumsum of the validity flags, bracketed at the run
+    boundaries by a forward cummax / reverse cummin (builtin cumulative
+    ops — see _SortedTally.count). The sorted VALUES are never masked
+    to sentinels, so arbitrary 32-bit payloads are safe;
   * both phases' tallies chain elementwise in sorted order and ONE
     unsort (a second payload sort) returns the results (arbitrary-index
     gathers run on the serial gather unit, ~15 ms per [16, 100k] pass
@@ -91,27 +92,22 @@ class _SortedTally:
 
     def count(self, valid_sn_sorted):
         """Per-position count of valid entries in its equal-value run —
-        gather-free: a forward segmented sum (reset at run starts) puts
-        the run total at each run's END; within a run the prefix is
-        nondecreasing, so a reverse segmented MAX (reset at run ends)
-        propagates that total back to every member."""
+        gather-free AND custom-scan-free. The plain (unsegmented)
+        inclusive cumsum ``s`` is nondecreasing, so the exclusive value
+        at a position's run START is the max of boundary-masked
+        ``s - f`` at-or-left of it (forward cummax), and the inclusive
+        value at its run END is the min of boundary-masked ``s``
+        at-or-right of it (reverse cummin); the difference is the run's
+        valid count. Builtin cumsum/cummax/cummin keep the optimized
+        TPU lowering — a custom-combine ``lax.associative_scan`` lowers
+        to ~17 levels of slice/pad/interleave passes that were ~35% of
+        the 100k program."""
         f = valid_sn_sorted.astype(jnp.int32)
-
-        def seg_sum(a, b):
-            s1, _ = a
-            s2, b2 = b
-            return (jnp.where(b2, s2, s1 + s2), a[1] | b2)
-
-        s, _ = jax.lax.associative_scan(seg_sum, (f, self.newrun), axis=1)
-
-        def seg_max(a, b):
-            m1, _ = a
-            m2, b2 = b
-            return (jnp.where(b2, m2, jnp.maximum(m1, m2)), a[1] | b2)
-
-        tot, _ = jax.lax.associative_scan(seg_max, (s, self.endrun),
-                                          axis=1, reverse=True)
-        return tot
+        s = jnp.cumsum(f, axis=1)
+        ex_start = jax.lax.cummax(jnp.where(self.newrun, s - f, -1), axis=1)
+        s_end = jax.lax.cummin(jnp.where(self.endrun, s, jnp.int32(2**30)),
+                               axis=1, reverse=True)
+        return s_end - ex_start
 
     def unsort(self, packed_sn):
         """Sorted-order [S, N] i32 payload → original [N, S] order via
