@@ -154,10 +154,17 @@ def run_benchmark(args, metric: str, extra: dict | None = None) -> None:
     # pull (~MBs of logs over the remote tunnel) happens once below, for
     # the sanity check — it is a one-time epilogue, not part of the
     # per-round throughput the metric defines (BASELINE.json:2).
+    # Each repeat dispatches a DIFFERENT seed vector (offset by
+    # (i+1)*n_sweeps): the tunnel caches identical dispatches (ADVICE
+    # r5 / docs/PERF.md r5), and the branchless kernels make throughput
+    # seed-invariant. The sanity check reads the kept warmup carry.
+    import dataclasses
     best = float("inf")
     for i in range(args.repeats):
+        seeds = runner.make_seeds(dataclasses.replace(
+            cfg, seed=cfg.seed + (i + 1) * cfg.n_sweeps))
         t0 = time.perf_counter()
-        carry = runner.run_device(cfg, eng)
+        runner.run_device(cfg, eng, seeds=seeds)
         dt = time.perf_counter() - t0
         best = min(best, dt)
         log(f"run {i}: {dt:.3f}s = {steps / dt / 1e6:.2f}M steps/s")

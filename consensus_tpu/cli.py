@@ -64,7 +64,28 @@ def build_parser() -> argparse.ArgumentParser:
                     help="device mesh, e.g. '8' (sweep-parallel) or '2x4' "
                          "(sweep x node); TPU engine only")
     ap.add_argument("--checkpoint", default="",
-                    help="checkpoint file; resumes from it if present")
+                    help="checkpoint file; resumes from the newest valid "
+                         "(checksum-verified) rotation if present")
+    ap.add_argument("--keep-checkpoints", type=int,
+                    default=argparse.SUPPRESS,
+                    help="retain the last K checkpoint rotations "
+                         "(ckpt.npz, ckpt.1.npz, ...; default 2) so a "
+                         "torn latest snapshot still leaves a valid "
+                         "fallback; requires --checkpoint")
+    ap.add_argument("--retries", type=int, default=0,
+                    help="supervised execution: retry transient failures "
+                         "up to N times with exponential backoff, resuming "
+                         "from the newest valid checkpoint between "
+                         "attempts (docs/RESILIENCE.md)")
+    ap.add_argument("--deadline", type=float, default=0.0,
+                    help="supervised execution: wall-clock budget in "
+                         "seconds — no new attempt starts past it "
+                         "(0 = unlimited)")
+    ap.add_argument("--fallback-cpu", action="store_true",
+                    help="supervised execution: once retries/deadline are "
+                         "exhausted, degrade to the CPU oracle engine "
+                         "(sound: both engines are decided-log "
+                         "digest-equivalent by contract)")
     ap.add_argument("--out", default="", help="dump raw payload bytes")
     ap.add_argument("--profile", default="",
                     help="write a jax.profiler trace to this directory "
@@ -174,6 +195,10 @@ def main(argv=None) -> int:
             ("--mesh" if "mesh" in typed else "config field mesh_shape",
              "mesh" in typed or cfg.mesh_shape),
             ("--checkpoint", args.checkpoint),
+            ("--keep-checkpoints", "keep_checkpoints" in typed),
+            ("--retries", args.retries),
+            ("--deadline", args.deadline),
+            ("--fallback-cpu", args.fallback_cpu),
             ("--profile", args.profile),
             ("--scan-chunk" if "scan_chunk" in typed
              else "config field scan_chunk",
@@ -192,12 +217,28 @@ def main(argv=None) -> int:
                      "grouping (one snapshot per group is not a layout "
                      "anything resumes); use --scan-chunk for mid-run "
                      "snapshots or drop --sweep-chunk")
+    keep = getattr(args, "keep_checkpoints", 2)
+    if "keep_checkpoints" in vars(args) and not args.checkpoint:
+        parser.error("--keep-checkpoints requires --checkpoint (it is the "
+                     "snapshot rotation depth)")
+    if keep < 1:
+        parser.error(f"--keep-checkpoints must be >= 1, got {keep}")
+    if args.retries < 0:
+        parser.error(f"--retries must be >= 0, got {args.retries}")
+    if args.deadline < 0:
+        parser.error(f"--deadline must be >= 0, got {args.deadline}")
+    supervise = bool(args.retries or args.deadline or args.fallback_cpu)
+    if supervise and args.profile:
+        parser.error("--profile is not supported with supervised execution "
+                     "(--retries/--deadline/--fallback-cpu): a retried "
+                     "attempt would overwrite the trace mid-stream")
     if args.f_sweep:
         if cfg.protocol != "pbft" or cfg.engine != "tpu":
             parser.error("--f-sweep requires --protocol pbft --engine tpu")
         unsupported = [name for name, on in [
             ("--checkpoint", args.checkpoint),
             ("--profile", args.profile),
+            ("--retries/--deadline/--fallback-cpu", supervise),
             ("--sweeps", cfg.n_sweeps != 1),
             ("--fault-model bcast", cfg.fault_model == "bcast"),
         ] if on]
@@ -226,9 +267,18 @@ def main(argv=None) -> int:
 
     run_kw = {}
     if args.checkpoint:
-        run_kw = dict(checkpoint_path=args.checkpoint, resume=True)
+        run_kw = dict(checkpoint_path=args.checkpoint, resume=True,
+                      keep_checkpoints=keep)
 
-    if args.profile and cfg.engine == "tpu":
+    if supervise:
+        from .network import supervisor
+        result = supervisor.supervised_run(
+            cfg, retries=args.retries,
+            deadline_s=args.deadline or None,
+            fallback_cpu=args.fallback_cpu,
+            checkpoint_path=args.checkpoint or None,
+            keep_checkpoints=keep)
+    elif args.profile and cfg.engine == "tpu":
         import jax
         with jax.profiler.trace(args.profile):
             result = simulator.run(cfg, **run_kw)
@@ -241,7 +291,9 @@ def main(argv=None) -> int:
             f.write(result.payload)
 
     report = {
-        "protocol": cfg.protocol, "engine": cfg.engine,
+        # result.config.engine, not cfg.engine: a supervised run may have
+        # degraded to the CPU oracle (fallback_used below says so).
+        "protocol": cfg.protocol, "engine": result.config.engine,
         "platform": platform_tag,
         "n_nodes": cfg.n_nodes, "n_rounds": cfg.n_rounds,
         "n_sweeps": cfg.n_sweeps, "seed": cfg.seed,
@@ -255,6 +307,13 @@ def main(argv=None) -> int:
         # steps/sec includes jit+compile (checkpoint runs skip warmup) —
         # flag it so the number isn't read as steady-state throughput.
         report["timing_includes_compile"] = True
+    rr = result.extras.get("run_report")
+    if rr is not None:
+        report["attempts"] = rr["n_attempts"]
+        report["resumed_from_round"] = rr["resumed_from_round"]
+        report["fallback_used"] = rr["fallback_used"]
+        if rr["fallback_used"]:
+            report["platform"] = "oracle"
     print(json.dumps(report))
     return 0
 

@@ -1,0 +1,8 @@
+"""Execution layer: the round-loop runner (scan/vmap/mesh + verified
+checkpoints), the engine-agnostic simulator front door, the retry/resume
+supervisor, and the test-only fault-injection harness.
+
+Submodules are imported lazily by callers (`from consensus_tpu.network
+import simulator`) — importing this package must stay free of jax work
+so the CLI can validate flags before any backend probe.
+"""

@@ -1,0 +1,159 @@
+"""Test-only fault-injection harness for the execution layer.
+
+The resilience story (docs/RESILIENCE.md) is only as trustworthy as the
+fault scenarios actually exercised against it — "From Consensus to
+Chaos" (PAPERS.md) makes the same argument about the consensus
+protocols themselves. This module provides the injectable failure
+modes the resilience tests drive:
+
+  * **kill after chunk k** — SIGKILL the process right after the k-th
+    scan chunk completes (and its checkpoint, if any, is written), so a
+    subprocess test can prove an interrupted-then-resumed run's digest
+    is bit-identical to an uninterrupted one;
+  * **transient error on the n-th dispatch** — raise
+    :class:`InjectedTransientError` before the n-th chunk dispatch, to
+    exercise the supervisor's retry/resume loop without a real device
+    flake;
+  * **corrupt / truncate checkpoint bytes** — host-side helpers that
+    damage a snapshot the way a torn write or bit-rot would, to prove
+    the checksum manifest detects it and recovery falls back to an
+    older rotation.
+
+The hooks are wired into :mod:`consensus_tpu.network.runner` and cost
+one ``is None`` check per scan chunk when no plan is installed — the
+production path never pays for the harness. A plan is installed either
+programmatically (:func:`install` / :func:`reset`, in-process tests) or
+via the ``CONSENSUS_TPU_FAULTS`` environment variable (JSON, read once
+at first hook call — how the subprocess crash tests reach into a child
+``python -m consensus_tpu``), e.g.::
+
+    CONSENSUS_TPU_FAULTS='{"kill_after_chunk": 2}'
+    CONSENSUS_TPU_FAULTS='{"transient_dispatches": [2, 3]}'
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+import signal
+import sys
+
+ENV_VAR = "CONSENSUS_TPU_FAULTS"
+
+
+class InjectedTransientError(RuntimeError):
+    """A synthetic transient failure (stands in for a device/tunnel
+    flake). The supervisor classifies it as retryable."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    # SIGKILL this process after the k-th (1-based) completed scan chunk,
+    # *after* its checkpoint (if any) has been written.
+    kill_after_chunk: int | None = None
+    # Raise InjectedTransientError before these (1-based) chunk
+    # dispatches. Counters are process-global, so a plan spanning a
+    # supervised retry ("fail dispatch 2, let the retry's dispatches
+    # through") needs no re-arming between attempts.
+    transient_dispatches: tuple = ()
+
+
+_PLAN: FaultPlan | None = None
+_ENV_CHECKED = False
+_dispatches = 0
+_chunks = 0
+
+
+def install(**kw) -> FaultPlan:
+    """Install a fault plan (in-process tests) and zero the counters."""
+    global _PLAN, _ENV_CHECKED, _dispatches, _chunks
+    kw["transient_dispatches"] = tuple(kw.get("transient_dispatches", ()))
+    _PLAN = FaultPlan(**kw)
+    _ENV_CHECKED = True
+    _dispatches = _chunks = 0
+    return _PLAN
+
+
+def reset() -> None:
+    """Remove any installed plan and zero the counters."""
+    global _PLAN, _ENV_CHECKED, _dispatches, _chunks
+    _PLAN = None
+    _ENV_CHECKED = True  # an explicit reset also wins over the env
+    _dispatches = _chunks = 0
+
+
+def _active() -> FaultPlan | None:
+    global _PLAN, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        spec = os.environ.get(ENV_VAR)
+        if spec:
+            d = json.loads(spec)
+            d["transient_dispatches"] = tuple(d.get("transient_dispatches",
+                                                    ()))
+            _PLAN = FaultPlan(**d)
+    return _PLAN
+
+
+def on_dispatch() -> None:
+    """Called by the runner before each scan-chunk dispatch."""
+    global _dispatches
+    plan = _active()
+    if plan is None:
+        return
+    _dispatches += 1
+    if _dispatches in plan.transient_dispatches:
+        raise InjectedTransientError(
+            f"injected transient failure on dispatch {_dispatches}")
+
+
+def on_chunk_end() -> None:
+    """Called by the runner after each scan chunk completes (and after
+    its between-chunk checkpoint, if any, has been written)."""
+    global _chunks
+    plan = _active()
+    if plan is None:
+        return
+    _chunks += 1
+    if plan.kill_after_chunk is not None and \
+            _chunks == plan.kill_after_chunk:
+        print(f"faults: SIGKILL after chunk {_chunks}", file=sys.stderr,
+              flush=True)
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+# --- checkpoint damage (host-side helpers; no hook needed) -------------------
+
+def corrupt_checkpoint(path, mode: str = "flip") -> None:
+    """Damage a snapshot file the way real failures would.
+
+    ``truncate``    — keep only the first third of the file (torn write);
+    ``flip``        — XOR one byte mid-file (bit rot / bad sector; lands
+                      in a stored npz member, so either the zip-level or
+                      the manifest-level CRC catches it);
+    ``leaf-tamper`` — rewrite the archive with one leaf's bytes modified
+                      but the ORIGINAL ``__meta__`` kept: the zip
+                      container is internally consistent, so only the
+                      per-leaf CRC32 manifest can detect the damage.
+    """
+    import numpy as np
+
+    path = pathlib.Path(path)
+    data = bytearray(path.read_bytes())
+    if mode == "truncate":
+        path.write_bytes(bytes(data[: max(1, len(data) // 3)]))
+    elif mode == "flip":
+        data[len(data) // 2] ^= 0xFF
+        path.write_bytes(bytes(data))
+    elif mode == "leaf-tamper":
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+        leaf = arrays["leaf_0"]
+        flipped = leaf.copy()
+        flipped.reshape(-1).view(np.uint8)[0] ^= 0xFF
+        arrays["leaf_0"] = flipped
+        np.savez(path, **arrays)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")

@@ -14,6 +14,11 @@ On top of the plain loop it provides, uniformly for every protocol:
     arrays) can be snapshotted to an .npz; a resumed run continues the
     scan at the saved round and produces bit-identical decided logs
     because every round kernel is a pure function of (state, round).
+    Snapshots carry a per-leaf CRC32 + manifest checksum and rotate the
+    last K files, so a torn/corrupted latest file is detected and
+    recovery falls back to the previous rotation (docs/RESILIENCE.md;
+    supervised retry/resume lives in network/supervisor.py, the
+    crash-injection hooks in network/faults.py).
 
 Engines register an :class:`EngineDef`; no protocol code lives here.
 """
@@ -23,6 +28,9 @@ import dataclasses
 import functools
 import json
 import pathlib
+import sys
+import zipfile
+import zlib
 from typing import Any, Callable
 
 import jax
@@ -31,6 +39,7 @@ import numpy as np
 
 from ..core.config import Config
 from ..parallel import mesh as meshlib
+from . import faults
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,77 +114,231 @@ def _sync_elem(a):
 
 
 # --- checkpointing -----------------------------------------------------------
+#
+# Format (docs/RESILIENCE.md): one .npz per snapshot holding the carry
+# leaves (leaf_0..leaf_{n-1}) plus a JSON ``__meta__`` record:
+#
+#   {"config": {...}, "next_round": R, "seeds": [...],
+#    "integrity": {"leaf_crc32": [...],    # CRC32 of each leaf's raw bytes
+#                  "manifest_crc32": C}}   # CRC32 over (config, next_round,
+#                                          #   seeds, leaf_crc32) — canonical
+#                                          #   sorted-key JSON
+#
+# Writes are atomic (tmp + rename) and rotate the last ``keep`` snapshots
+# (ckpt.npz, ckpt.1.npz, ...); loads scan newest -> oldest and return the
+# first snapshot that is both INTACT (zip readable, manifest + per-leaf
+# checksums verify) and MATCHING (config / seed vector), so a torn or
+# bit-rotted latest file costs one rotation of progress, not the run.
+# Pre-integrity-era snapshots (no "integrity" key) are accepted as-is.
+
+
+class CheckpointError(Exception):
+    """A snapshot file exists but is unreadable or fails its checksums
+    (torn write, truncation, bit rot, stale manifest)."""
+
+
+def _leaf_crc(a) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes())
+
+
+def _manifest_crc(config: dict, next_round: int, seeds: list,
+                  leaf_crc32: list) -> int:
+    return zlib.crc32(json.dumps(
+        {"config": config, "next_round": next_round, "seeds": seeds,
+         "leaf_crc32": leaf_crc32}, sort_keys=True).encode())
+
+
+def rotation_path(path, i: int) -> pathlib.Path:
+    """The i-th rotated snapshot of ``path``: ckpt.npz -> ckpt.{i}.npz
+    (i=0 is ``path`` itself)."""
+    p = pathlib.Path(path)
+    return p if i == 0 else p.with_name(f"{p.stem}.{i}{p.suffix}")
+
+
+def checkpoint_candidates(path) -> list:
+    """Existing snapshot paths for ``path``, newest first.
+
+    Tolerates ONE missing rung before stopping: save_checkpoint's
+    rotation is a sequence of single renames, so a kill mid-rotation
+    leaves exactly one hole (most commonly index 0, killed between the
+    rotate and the final tmp rename) — the still-valid older rungs
+    behind it must stay reachable or the "torn latest leaves a
+    fallback" guarantee dies in precisely the crash window it exists
+    for. Two consecutive missing indices mean the set really ends;
+    anything beyond is leftover from an unrelated older run (and would
+    be config-checked anyway)."""
+    out, i, misses = [], 0, 0
+    while misses < 2:
+        p = rotation_path(path, i)
+        if p.exists():
+            out.append(p)
+            misses = 0
+        else:
+            misses += 1
+        i += 1
+    return out
+
 
 def save_checkpoint(path, cfg: Config, carry, next_round: int,
-                    seeds=None) -> None:
+                    seeds=None, keep: int = 1) -> None:
     """Snapshot the batched carry after ``next_round`` rounds have run.
 
     ``seeds`` records the per-sweep seed vector the carry was produced
     with (default: ``make_seeds(cfg)``) so a resume under different
     explicit seeds is detected as a mismatch, not silently continued.
+
+    ``keep`` retains the last ``keep`` snapshots: before the atomic
+    tmp+rename of the new file, existing snapshots rotate
+    ckpt.npz -> ckpt.1.npz -> ... -> ckpt.{keep-1}.npz (the oldest is
+    dropped). Every step is a single rename, so a kill at any point
+    leaves only whole files — recovery never sees a half-rotated state
+    worse than one missing rung.
     """
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
     leaves, _ = jax.tree.flatten(carry)
-    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays = {f"leaf_{i}": np.ascontiguousarray(x)
+              for i, x in enumerate(leaves)}
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_suffix(".tmp.npz")
     seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
-    np.savez(tmp, __meta__=np.frombuffer(json.dumps(
-        {"config": json.loads(cfg.to_json()), "next_round": next_round,
-         "seeds": [int(s) for s in seeds]}
-    ).encode(), dtype=np.uint8), **arrays)
+    config = json.loads(cfg.to_json())
+    seed_list = [int(s) for s in seeds]
+    leaf_crc32 = [_leaf_crc(arrays[f"leaf_{i}"]) for i in range(len(leaves))]
+    meta = {"config": config, "next_round": next_round, "seeds": seed_list,
+            "integrity": {
+                "leaf_crc32": leaf_crc32,
+                "manifest_crc32": _manifest_crc(config, next_round,
+                                                seed_list, leaf_crc32)}}
+    np.savez(tmp, __meta__=np.frombuffer(json.dumps(meta).encode(),
+                                         dtype=np.uint8), **arrays)
+    for i in range(keep - 1, 0, -1):
+        src = rotation_path(path, i - 1)
+        if src.exists():
+            src.replace(rotation_path(path, i))
     tmp.replace(path)
 
 
+def _read_verified(path):
+    """Read one snapshot file; return (meta, leaves: list[np.ndarray]).
+
+    Raises :class:`CheckpointError` when the file is unreadable or its
+    recorded checksums don't verify. Snapshots without an "integrity"
+    record (pre-manifest era) are read as-is — the zip container's own
+    member CRCs still cover gross corruption for those.
+    """
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            integ = meta.get("integrity")
+            n = (len(integ["leaf_crc32"]) if integ
+                 else len(z.files) - 1)
+            leaves = [np.asarray(z[f"leaf_{i}"]) for i in range(n)]
+    except (zipfile.BadZipFile, zlib.error, OSError, EOFError, KeyError,
+            ValueError) as exc:  # ValueError covers JSON/Unicode decode
+        raise CheckpointError(f"{path}: unreadable snapshot: {exc!r}")
+    if integ:
+        want = _manifest_crc(meta.get("config"), meta.get("next_round"),
+                             meta.get("seeds"), integ["leaf_crc32"])
+        if integ.get("manifest_crc32") != want:
+            raise CheckpointError(f"{path}: manifest checksum mismatch")
+        for i, (leaf, crc) in enumerate(zip(leaves, integ["leaf_crc32"])):
+            if _leaf_crc(leaf) != crc:
+                raise CheckpointError(f"{path}: leaf_{i} checksum mismatch")
+    return meta, leaves
+
+
+def _meta_matches(meta: dict, cfg: Config, seeds) -> bool:
+    """Does a verified snapshot's meta belong to (cfg, seeds)?"""
+    # Round-trip the saved dict through Config so a field added to
+    # the schema AFTER the snapshot was written compares at its
+    # default (a pre-sweep_chunk checkpoint ran with sweep_chunk=0
+    # semantics by definition) instead of silently invalidating
+    # every existing checkpoint via a key-for-key dict mismatch.
+    # Keys NOT in the current schema mean the snapshot came from a
+    # *newer* (or foreign) semantics — reject those rather than
+    # resume a carry whose meaning we can't represent; likewise a
+    # saved config today's validation refuses is a mismatch, not a
+    # crash.
+    saved = {k: v for k, v in meta["config"].items() if k != "_cutoffs"}
+    if not set(saved) <= {f.name for f in dataclasses.fields(Config)}:
+        return False
+    try:
+        if Config.from_json(json.dumps(saved)) != cfg:
+            return False
+    except (ValueError, TypeError):
+        return False
+    want = make_seeds(cfg) if seeds is None else np.asarray(seeds)
+    have = meta.get("seeds")
+    have = make_seeds(cfg) if have is None else np.asarray(have)
+    return bool(np.array_equal(want.astype(np.uint32),
+                               have.astype(np.uint32)))
+
+
+def _log_ckpt(msg: str) -> None:
+    print(f"checkpoint: {msg}", file=sys.stderr, flush=True)
+
+
+def _scan_valid(path, cfg: Config, seeds):
+    """Yield (meta, leaves) for each intact AND matching snapshot of
+    ``path``, newest rotation first; warn (stderr) on corrupt files."""
+    for cand in checkpoint_candidates(path):
+        try:
+            meta, leaves = _read_verified(cand)
+        except CheckpointError as exc:
+            _log_ckpt(f"{exc} — trying older rotation")
+            continue
+        if _meta_matches(meta, cfg, seeds):
+            yield cand, meta, leaves
+
+
 def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None):
-    """Return (carry, next_round) or None if absent / config mismatch.
+    """Return (carry, next_round) from the newest VALID snapshot of
+    ``path`` — or None when no rotation is both intact and matching.
 
     ``seeds`` is the seed vector the caller will resume under (default
     ``make_seeds(cfg)``); a snapshot taken under a different vector is a
     mismatch — its carry belongs to other trajectories. Snapshots from
     before seeds were recorded compare at ``make_seeds(cfg)``, which is
     what they necessarily ran with.
+
+    A torn/corrupted rotation (checksum or container failure) is
+    skipped with a warning and the next-oldest is tried: recovery costs
+    one rotation of progress, never the whole run.
     """
-    path = pathlib.Path(path)
-    if not path.exists():
-        return None
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["__meta__"]).decode())
-        # Round-trip the saved dict through Config so a field added to
-        # the schema AFTER the snapshot was written compares at its
-        # default (a pre-sweep_chunk checkpoint ran with sweep_chunk=0
-        # semantics by definition) instead of silently invalidating
-        # every existing checkpoint via a key-for-key dict mismatch.
-        # Keys NOT in the current schema mean the snapshot came from a
-        # *newer* (or foreign) semantics — reject those rather than
-        # resume a carry whose meaning we can't represent; likewise a
-        # saved config today's validation refuses is a mismatch, not a
-        # crash.
-        saved = {k: v for k, v in meta["config"].items() if k != "_cutoffs"}
-        if not set(saved) <= {f.name for f in dataclasses.fields(Config)}:
-            return None
-        try:
-            if Config.from_json(json.dumps(saved)) != cfg:
-                return None
-        except (ValueError, TypeError):
-            return None
-        want = make_seeds(cfg) if seeds is None else np.asarray(seeds)
-        have = meta.get("seeds")
-        have = make_seeds(cfg) if have is None else np.asarray(have)
-        if not np.array_equal(want.astype(np.uint32),
-                              have.astype(np.uint32)):
-            return None
-        leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
-    template = jax.eval_shape(lambda s: _init_template(cfg, eng, s),
-                              jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32))
-    # Cast to the template dtypes: an engine may narrow a state field's
-    # storage dtype between versions (e.g. raft match/next i32 -> u8);
-    # the saved integer values are identical, but lax.scan requires the
-    # carry dtype to match what round_fn returns.
-    leaves = [np.asarray(leaf).astype(t.dtype)
-              for leaf, t in zip(leaves, jax.tree.leaves(template))]
-    treedef = jax.tree.structure(template)
-    return jax.tree.unflatten(treedef, leaves), meta["next_round"]
+    for cand, meta, leaves in _scan_valid(path, cfg, seeds):
+        if cand != pathlib.Path(path):
+            _log_ckpt(f"recovered from rotation {cand} "
+                      f"(round {meta['next_round']})")
+        template = jax.eval_shape(
+            lambda s: _init_template(cfg, eng, s),
+            jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32))
+        # Cast to the template dtypes: an engine may narrow a state
+        # field's storage dtype between versions (e.g. raft match/next
+        # i32 -> u8); the saved integer values are identical, but
+        # lax.scan requires the carry dtype to match what round_fn
+        # returns.
+        leaves = [np.asarray(leaf).astype(t.dtype)
+                  for leaf, t in zip(leaves, jax.tree.leaves(template))]
+        treedef = jax.tree.structure(template)
+        return jax.tree.unflatten(treedef, leaves), meta["next_round"]
+    return None
+
+
+def peek_checkpoint(path, cfg: Config, seeds=None):
+    """``next_round`` of the snapshot :func:`load_checkpoint` would
+    resume from (newest intact + matching rotation), or None.
+
+    Runs the FULL validation load_checkpoint runs — container, manifest
+    and per-leaf checksums, config and seed match — so its answer
+    exactly predicts a subsequent load; it only skips the dtype-cast /
+    unflatten epilogue. That makes it a full snapshot read: use it as a
+    diagnostic probe, not on a hot path (the supervisor reads each
+    attempt's start round from ``stats`` instead)."""
+    for _, meta, _ in _scan_valid(path, cfg, seeds):
+        return meta["next_round"]
+    return None
 
 
 def _init_template(cfg, eng, seeds):
@@ -246,15 +409,24 @@ def _prepare(cfg: Config, eng: EngineDef, mesh, seeds=None):
 
 
 def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
-             mesh, checkpoint_path=None, seeds=None):
-    """Drive fixed-shape jitted chunks from ``start`` to ``cfg.n_rounds``."""
+             mesh, checkpoint_path=None, seeds=None, keep: int = 1):
+    """Drive fixed-shape jitted chunks from ``start`` to ``cfg.n_rounds``.
+
+    The two ``faults`` hooks are the crash-injection harness's seams
+    (one ``is None`` check each when no plan is installed): a transient
+    error fires BEFORE a chunk dispatches; a kill fires AFTER a chunk
+    completes and its checkpoint (if any) is durably on disk.
+    """
     r = start
     while r < cfg.n_rounds:
+        faults.on_dispatch()
         n = min(chunk, cfg.n_rounds - r)
         carry = _chunk_jit(cfg, eng, n, carry, jnp.int32(r), mesh=mesh)
         r += n
         if checkpoint_path and r < cfg.n_rounds:
-            save_checkpoint(checkpoint_path, cfg, carry, r, seeds=seeds)
+            save_checkpoint(checkpoint_path, cfg, carry, r, seeds=seeds,
+                            keep=keep)
+        faults.on_chunk_end()
     return carry
 
 
@@ -297,13 +469,15 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
 
 def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         resume: bool = False, stats: dict | None = None,
-        seeds=None) -> dict:
+        seeds=None, keep_checkpoints: int = 2) -> dict:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
     chunk size, the host drives fixed-shape chunks (one compile for the
     common size + one for the ragged tail) and optionally checkpoints
-    between them.
+    between them, rotating the last ``keep_checkpoints`` snapshots
+    (default 2, so a torn latest file still leaves a valid fallback —
+    docs/RESILIENCE.md).
 
     If ``stats`` is given it is filled with ``start_round`` and
     ``executed_rounds`` so callers can report throughput for the rounds
@@ -348,11 +522,16 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         chunk = min(64, max(1, cfg.n_rounds // 2))
     else:
         chunk = cfg.n_rounds
-    carry = _advance(cfg, eng, carry, start, chunk, mesh, checkpoint_path,
-                     seeds=np.asarray(seeds))
-
+    # start_round is known BEFORE the advance and is recorded first, so
+    # a caller whose run dies mid-flight (the supervisor's per-attempt
+    # records) still learns where the attempt began without re-reading
+    # and re-verifying the snapshot it just loaded.
     if stats is not None:
         stats["start_round"] = start
+    carry = _advance(cfg, eng, carry, start, chunk, mesh, checkpoint_path,
+                     seeds=np.asarray(seeds), keep=keep_checkpoints)
+
+    if stats is not None:
         stats["executed_rounds"] = cfg.n_rounds - start
 
     return {k: np.asarray(v) for k, v in eng.extract(carry).items()}

@@ -40,7 +40,9 @@ class RunResult:
     timing_includes_compile: bool = False
     # Protocol-specific derived outputs (dpos: the SPEC §7 `lib` index),
     # computed engine-independently from the decided records so both
-    # front doors report the same extras (ADVICE r4).
+    # front doors report the same extras (ADVICE r4). A supervised run
+    # (network/supervisor.py) additionally records its structured
+    # RunReport here under "run_report".
     extras: dict = dataclasses.field(default_factory=dict)
 
     @property
@@ -68,8 +70,12 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
     executed_rounds = cfg.n_rounds
     timing_includes_compile = False
     if cfg.engine == "tpu":
-        stats: dict = {}
-        kw = dict(engine_kw, stats=stats)
+        # Honor a caller-provided stats dict (it is filled in place by
+        # runner.run) instead of silently shadowing it with our own.
+        kw = dict(engine_kw)
+        if kw.get("stats") is None:
+            kw["stats"] = {}
+        stats: dict = kw["stats"]
         warm = warmup and not engine_kw.get("checkpoint_path")
         if warm:
             _run_jax(cfg, **kw)  # compile; discard result

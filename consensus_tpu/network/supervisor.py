@@ -1,0 +1,198 @@
+"""Supervised execution: bounded retry + resume around the simulator.
+
+The checkpoint layer (network/runner.py) makes an interrupted run
+*resumable*; this module makes the recovery *automatic*. A supervised
+run wraps :func:`consensus_tpu.network.simulator.run` with:
+
+  * **bounded retry with exponential backoff** on transient errors (a
+    dropped device tunnel, an RPC flake, an injected fault) — permanent
+    errors (bad config, shape mismatch) re-raise immediately;
+  * **resume-from-newest-valid-checkpoint** between attempts: each
+    retry continues from whatever the verified rotation set proves was
+    durably completed, so a flake costs one chunk of progress, not
+    hours of sweeps;
+  * **a wall-clock deadline** gating new attempts (a running attempt is
+    never interrupted — JAX dispatches can't be safely cancelled);
+  * **opt-in graceful degradation to the CPU oracle** once retries or
+    the deadline are exhausted — sound because both engines are
+    decided-log digest-equivalent by contract (docs/SPEC.md,
+    BASELINE.json:2);
+  * a structured :class:`RunReport` (per-attempt outcomes, resume
+    round, fallback flag) surfaced through ``RunResult.extras
+    ["run_report"]`` so callers — including the CLI's ``--retries /
+    --deadline / --fallback-cpu`` flags — can audit what actually
+    happened.
+
+Soundness: resuming never changes results. Every round kernel is a pure
+function of (state, round) and the checkpoint layer refuses any
+snapshot whose checksums, config, or seed vector don't match, so a
+supervised run's digest is bit-identical to an uninterrupted one
+(tests/test_resilience.py proves this with real SIGKILLs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ..core.config import Config
+from . import faults, simulator
+
+
+class SupervisorError(RuntimeError):
+    """All attempts failed (retries and/or deadline exhausted) and CPU
+    fallback was not enabled. Carries the :class:`RunReport`."""
+
+    def __init__(self, msg: str, report: "RunReport"):
+        super().__init__(msg)
+        self.report = report
+
+
+# Exception types retrying can plausibly fix. PJRT/XLA runtime errors
+# are matched by name: the concrete class lives in jaxlib internals
+# whose import path is not stable across versions.
+_TRANSIENT_NAMES = frozenset({
+    "XlaRuntimeError", "JaxRuntimeError", "RpcError", "UnavailableError",
+    "InternalError", "AbortedError", "DeadlineExceededError"})
+# Permanent: caller/config errors — retrying replays the same failure.
+_PERMANENT_TYPES = (ValueError, TypeError, KeyError, AttributeError,
+                    NotImplementedError, AssertionError)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Is retrying this failure plausibly useful? Device/tunnel/IO
+    flakes are; usage and semantic errors are not."""
+    if isinstance(exc, faults.InjectedTransientError):
+        return True
+    if isinstance(exc, _PERMANENT_TYPES):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+        return True
+    return any(c.__name__ in _TRANSIENT_NAMES for c in type(exc).__mro__)
+
+
+@dataclasses.dataclass
+class Attempt:
+    index: int              # 0-based attempt number
+    start_round: int        # round the attempt began at (0 = fresh)
+    wall_s: float
+    error: str | None = None  # None = the attempt succeeded
+
+
+@dataclasses.dataclass
+class RunReport:
+    """What the supervisor actually did — one entry per attempt."""
+    retries: int
+    attempts: list = dataclasses.field(default_factory=list)
+    resumed_from_round: int = 0       # successful attempt's start round
+    fallback_used: bool = False
+    deadline_exceeded: bool = False
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["n_attempts"] = len(self.attempts)
+        return d
+
+
+def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
+                   backoff_cap_s: float = 30.0, deadline_s: float | None = None,
+                   fallback_cpu: bool = False, checkpoint_path=None,
+                   keep_checkpoints: int = 2, mesh=None, seeds=None,
+                   warmup: bool = False, sleep=time.sleep):
+    """Run ``cfg`` under supervision; return the :class:`RunResult` with
+    ``extras["run_report"]`` filled in.
+
+    ``retries`` bounds re-attempts after transient failures (total
+    attempts = retries + 1); between attempts the supervisor sleeps
+    ``backoff_s * 2**k`` (capped at ``backoff_cap_s``) and resumes from
+    the newest valid rotation of ``checkpoint_path`` (when given).
+    ``deadline_s`` is a wall-clock budget: no new attempt (or backoff
+    sleep) starts past it. When everything is exhausted,
+    ``fallback_cpu=True`` reruns the config on the CPU oracle engine —
+    digest-equivalent by contract — instead of raising
+    :class:`SupervisorError`.
+
+    ``warmup=False`` (default): a supervised run cares about completion,
+    not steady-state timing, so the compile-then-rerun warmup of
+    :func:`simulator.run` is skipped; ``RunResult.timing_includes_compile``
+    is set accordingly.
+    """
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    if fallback_cpu and cfg.engine != "tpu":
+        raise ValueError("fallback_cpu degrades the tpu engine to the CPU "
+                         f"oracle; cfg.engine={cfg.engine!r} already is it")
+    if fallback_cpu and seeds is not None:
+        raise ValueError(
+            "fallback_cpu cannot honor an explicit seeds vector: the CPU "
+            "oracle derives per-sweep seeds from cfg.seed (docs/SPEC.md §1), "
+            "so the degraded run would silently simulate different "
+            "trajectories than the supervised attempts")
+    if checkpoint_path and cfg.engine != "tpu":
+        raise ValueError("checkpoint_path is a tpu-engine feature "
+                         f"(cfg.engine={cfg.engine!r})")
+
+    report = RunReport(retries=retries)
+    t_start = time.monotonic()
+    last_exc: BaseException | None = None
+
+    for attempt in range(retries + 1):
+        if deadline_s is not None and time.monotonic() - t_start >= deadline_s:
+            report.deadline_exceeded = True
+            break
+        # Each attempt's true start round comes from the run's own stats
+        # (runner.run records it right after loading, before advancing),
+        # so even a failed attempt reports where it resumed — without a
+        # separate peek re-reading and re-verifying the snapshot.
+        stats: dict = {}
+        kw = {}
+        if cfg.engine == "tpu":
+            kw["stats"] = stats
+            if checkpoint_path:
+                kw.update(checkpoint_path=checkpoint_path, resume=True,
+                          keep_checkpoints=keep_checkpoints)
+            if mesh is not None:
+                kw["mesh"] = mesh
+            if seeds is not None:
+                kw["seeds"] = seeds
+        t0 = time.monotonic()
+        try:
+            result = simulator.run(cfg, warmup=warmup, **kw)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            wall = time.monotonic() - t0
+            if not is_transient(exc):
+                raise
+            report.attempts.append(Attempt(attempt,
+                                           stats.get("start_round", 0),
+                                           wall, error=repr(exc)))
+            last_exc = exc
+            if attempt < retries:
+                delay = min(backoff_cap_s, backoff_s * (2 ** attempt))
+                if deadline_s is not None:
+                    delay = min(delay, max(
+                        0.0, deadline_s - (time.monotonic() - t_start)))
+                if delay > 0:
+                    sleep(delay)
+            continue
+        start_round = stats.get("start_round", 0)
+        report.attempts.append(Attempt(attempt, start_round,
+                                       time.monotonic() - t0))
+        report.resumed_from_round = start_round
+        result.extras["run_report"] = report.to_dict()
+        return result
+
+    if fallback_cpu:
+        # Degrade to the scalar oracle: same Config schema, same decided
+        # logs byte-for-byte (the framework's acceptance criterion), so
+        # the caller still gets a correct result — just slowly. A fresh
+        # run: the oracle has no checkpoint/resume surface.
+        report.fallback_used = True
+        result = simulator.run(dataclasses.replace(cfg, engine="cpu"),
+                               warmup=False)
+        result.extras["run_report"] = report.to_dict()
+        return result
+    why = ("wall-clock deadline exceeded" if report.deadline_exceeded
+           else f"all {retries + 1} attempts failed")
+    raise SupervisorError(
+        f"supervised run gave up: {why} (last error: {last_exc!r}); "
+        "pass fallback_cpu=True to degrade to the CPU oracle",
+        report) from last_exc

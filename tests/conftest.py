@@ -21,3 +21,12 @@ jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()} — sharding tests "
     "would silently run unsharded")
+
+
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'` (ROADMAP.md); the slow tier holds the
+    # subprocess crash-injection tests (tests/test_resilience.py), each
+    # of which pays a full interpreter + jit-compile startup.
+    config.addinivalue_line(
+        "markers", "slow: subprocess/e2e resilience tests excluded from "
+                   "tier-1 (run with -m slow)")

@@ -137,12 +137,14 @@ def test_checkpoint_from_older_schema_still_resumes(tmp_path):
     runner.save_checkpoint(path, cfg, carry, 16)
 
     # Rewrite the snapshot's meta with sweep_chunk deleted, as a file
-    # written by the pre-sweep_chunk schema would have it.
+    # written by the pre-sweep_chunk schema would have it (that era also
+    # predates the seeds record and the integrity manifest).
     with np.load(path) as z:
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
         meta = json.loads(bytes(z["__meta__"]).decode())
     del meta["config"]["sweep_chunk"]
     del meta["seeds"]  # pre-recorded-seeds era: implies make_seeds(cfg)
+    meta.pop("integrity", None)
     np.savez(path, __meta__=np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8), **arrays)
 
@@ -192,6 +194,10 @@ def test_checkpoint_from_newer_schema_rejected(tmp_path):
     with np.load(path) as z:
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
         meta = json.loads(bytes(z["__meta__"]).decode())
+    # A foreign writer would have recorded its own manifest over its own
+    # meta; strip ours so the *schema* rejection path is what's tested,
+    # not the checksum one (tests/test_resilience.py covers checksums).
+    meta.pop("integrity", None)
     meta["config"]["future_adversary_mode"] = 3
     np.savez(path, __meta__=np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8), **arrays)
@@ -241,11 +247,19 @@ def test_checkpoint_from_wider_dtype_resumes_bit_identical(tmp_path):
     ckpt = tmp_path / "raft.ckpt.npz"
     runner.save_checkpoint(ckpt, cfg, carry, 16)
 
+    import json
     with np.load(ckpt) as z:
         widened = {k: (z[k] if k == "__meta__"
                        else np.asarray(z[k], dtype=np.int64)
                        if np.issubdtype(z[k].dtype, np.integer) else z[k])
                    for k in z.files}
+    # A wide-dtype-era writer predates the integrity manifest; strip it
+    # (its leaf CRCs describe the narrow bytes) so the dtype-cast path
+    # is what's exercised, not checksum rejection.
+    meta = json.loads(bytes(widened["__meta__"]).decode())
+    meta.pop("integrity", None)
+    widened["__meta__"] = np.frombuffer(json.dumps(meta).encode(),
+                                        dtype=np.uint8)
     np.savez(ckpt, **widened)
 
     resumed = raft.raft_run(cfg, checkpoint_path=ckpt, resume=True)
