@@ -113,8 +113,14 @@ def time_tpu(cfg: Config, repeats: int = 3) -> dict:
 
     from consensus_tpu.core import serialize
     from consensus_tpu.network import runner, simulator
+    from consensus_tpu.obs import metrics as obs_metrics
     eng = simulator.engine_def(cfg)
     warm_carry = runner.run_device(cfg, eng)  # compile + warm; base seed
+    # Per-config metrics delta: reset AFTER the warmup so the embedded
+    # dispatch histogram covers only the timed repeats — the per-chunk
+    # breakdown (dispatch vs checkpoint IO) each BENCH row finally
+    # carries alongside its totals (docs/OBSERVABILITY.md).
+    obs_metrics.reset()
     best = float("inf")
     for rep in range(repeats):
         seeds = runner.make_seeds(dataclasses.replace(
@@ -122,6 +128,7 @@ def time_tpu(cfg: Config, repeats: int = 3) -> dict:
         t0 = time.perf_counter()
         runner.run_device(cfg, eng, seeds=seeds)
         best = min(best, time.perf_counter() - t0)
+    metrics_snap = obs_metrics.snapshot()
     # Digest epilogue: extract from the warmup carry (base seed) — the
     # digest validates the same compiled kernel the repeats timed.
     out = {k: np.asarray(v) for k, v in eng.extract(warm_carry).items()}
@@ -129,7 +136,8 @@ def time_tpu(cfg: Config, repeats: int = 3) -> dict:
     steps = cfg.n_sweeps * cfg.n_nodes * cfg.n_rounds
     return {"engine": "tpu", "config": json.loads(cfg.to_json()),
             "steps": steps, "wall_s": best, "steps_per_sec": steps / best,
-            "digest": serialize.digest(payload)}
+            "digest": serialize.digest(payload),
+            "metrics": metrics_snap}
 
 
 def time_oracle(cfg: Config, repeats: int = 2) -> dict:

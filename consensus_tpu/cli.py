@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
 import sys
 
 
@@ -89,7 +90,30 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--out", default="", help="dump raw payload bytes")
     ap.add_argument("--profile", default="",
                     help="write a jax.profiler trace to this directory "
-                         "(TPU engine only)")
+                         "(TPU engine only); our span boundaries are "
+                         "mirrored into the profiler timeline "
+                         "(docs/OBSERVABILITY.md)")
+    ap.add_argument("--trace-out", default="",
+                    help="write span/event JSONL (dispatches, checkpoint "
+                         "IO, supervisor attempts) to this file; schema "
+                         "in docs/OBSERVABILITY.md, checked by "
+                         "tools/validate_trace.py")
+    ap.add_argument("--metrics-out", default="",
+                    help="write the metrics snapshot (dispatch histogram, "
+                         "checkpoint counters, retries) to this file — "
+                         "JSON, or Prometheus text format when the path "
+                         "ends in .prom; a supervised run also dumps its "
+                         "RunReport next to it as <stem>.run_report.json")
+    ap.add_argument("--telemetry", action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="accumulate on-device protocol counters (leader "
+                         "elections, quorum hits, promises/nacks, ...) "
+                         "alongside the scan carry and add their totals "
+                         "to the report (TPU engine only; digest-neutral "
+                         "— docs/OBSERVABILITY.md)")
+    ap.add_argument("-v", "--verbose", action="count", default=0,
+                    help="print checkpoint-IO timings and telemetry "
+                         "totals to stderr")
     ap.add_argument("--config", default="",
                     help="JSON config file; typed flags override its values")
     ap.add_argument("--platform", default="auto",
@@ -136,8 +160,12 @@ def _run_fsweep(cfg, args, platform_tag: str) -> int:
     from .core import serialize
     from .engines.pbft_sweep import fsweep_payload, pbft_fsweep_timed
 
+    from .obs import trace as obs_trace
+
     fs = args.parsed_fs
-    out, compile_s, wall, steps = pbft_fsweep_timed(cfg, fs)
+    with obs_trace.span("pbft_fsweep", n_elements=len(fs),
+                        n_rounds=cfg.n_rounds):
+        out, compile_s, wall, steps = pbft_fsweep_timed(cfg, fs)
     payload = fsweep_payload(out)
     if args.out:
         with open(args.out, "wb") as fp:
@@ -200,6 +228,7 @@ def main(argv=None) -> int:
             ("--deadline", args.deadline),
             ("--fallback-cpu", args.fallback_cpu),
             ("--profile", args.profile),
+            ("--telemetry", args.telemetry),
             ("--scan-chunk" if "scan_chunk" in typed
              else "config field scan_chunk",
              cfg.scan_chunk),
@@ -241,6 +270,7 @@ def main(argv=None) -> int:
             ("--retries/--deadline/--fallback-cpu", supervise),
             ("--sweeps", cfg.n_sweeps != 1),
             ("--fault-model bcast", cfg.fault_model == "bcast"),
+            ("--telemetry", args.telemetry),
         ] if on]
         if unsupported:
             parser.error(f"{', '.join(unsupported)}: not supported with "
@@ -260,6 +290,64 @@ def main(argv=None) -> int:
             platform_tag = ensure_platform(
                 args.platform, probe_timeout=args.probe_timeout)
 
+    from .obs import trace as obs_trace
+    if args.trace_out or args.profile:
+        # One sink for the whole run; with --profile our span boundaries
+        # are mirrored into the jax.profiler timeline so both traces
+        # line up (docs/OBSERVABILITY.md).
+        obs_trace.configure(args.trace_out or None,
+                            annotate_jax=bool(args.profile))
+    # _execute parks the supervised RunReport (success or give-up) here
+    # so the finally below can dump it next to the metrics snapshot.
+    report_holder: dict = {}
+    try:
+        return _execute(cfg, args, platform_tag, keep, supervise,
+                        report_holder)
+    finally:
+        # Written on EVERY exit path — a run that died mid-flight still
+        # leaves its partial dispatch/checkpoint data and (when
+        # supervised) the per-attempt record: the diagnosis artifacts
+        # matter most exactly when the run gave up.
+        if args.metrics_out:
+            _write_metrics(args, report_holder.get("run_report"))
+        obs_trace.close()
+
+
+def _write_metrics(args, run_report: dict | None) -> None:
+    """--metrics-out: snapshot the registry (JSON, or Prometheus text
+    for a .prom path); a supervised run's RunReport lands next to it.
+    Called from main's finally, so failing runs get their artifacts
+    too."""
+    from .obs import metrics as obs_metrics
+    path = pathlib.Path(args.metrics_out)
+    if path.suffix == ".prom":
+        path.write_text(obs_metrics.to_prometheus())
+    else:
+        path.write_text(json.dumps(
+            {"version": obs_metrics.SCHEMA_VERSION,
+             "metrics": obs_metrics.snapshot()}, indent=2))
+    if run_report is not None:
+        rpath = path.with_name(path.stem + ".run_report.json")
+        rpath.write_text(json.dumps(run_report, indent=2))
+        print(f"run report written to {rpath}", file=sys.stderr)
+
+
+def _print_verbose(result) -> None:
+    io = result.extras.get("checkpoint_io")
+    if io is not None:
+        print(f"checkpoint io: {io['saves']} saves "
+              f"({io['bytes_written']} B, {io['save_s']:.3f}s), "
+              f"{io['loads']} loads "
+              f"({io['bytes_read']} B, {io['load_s']:.3f}s)",
+              file=sys.stderr)
+    tel = result.extras.get("telemetry")
+    if tel is not None:
+        totals = " ".join(f"{k}={v}" for k, v in tel["totals"].items())
+        print(f"telemetry: {totals}", file=sys.stderr)
+
+
+def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
+             report_holder: dict) -> int:
     if args.f_sweep:
         return _run_fsweep(cfg, args, platform_tag)
 
@@ -269,15 +357,23 @@ def main(argv=None) -> int:
     if args.checkpoint:
         run_kw = dict(checkpoint_path=args.checkpoint, resume=True,
                       keep_checkpoints=keep)
+    if args.telemetry:
+        run_kw["telemetry"] = True
 
     if supervise:
         from .network import supervisor
-        result = supervisor.supervised_run(
-            cfg, retries=args.retries,
-            deadline_s=args.deadline or None,
-            fallback_cpu=args.fallback_cpu,
-            checkpoint_path=args.checkpoint or None,
-            keep_checkpoints=keep)
+        try:
+            result = supervisor.supervised_run(
+                cfg, retries=args.retries,
+                deadline_s=args.deadline or None,
+                fallback_cpu=args.fallback_cpu,
+                checkpoint_path=args.checkpoint or None,
+                keep_checkpoints=keep,
+                telemetry=args.telemetry)
+        except supervisor.SupervisorError as exc:
+            # Park the give-up report for main's finally to dump.
+            report_holder["run_report"] = exc.report.to_dict()
+            raise
     elif args.profile and cfg.engine == "tpu":
         import jax
         with jax.profiler.trace(args.profile):
@@ -307,13 +403,19 @@ def main(argv=None) -> int:
         # steps/sec includes jit+compile (checkpoint runs skip warmup) —
         # flag it so the number isn't read as steady-state throughput.
         report["timing_includes_compile"] = True
+    tel = result.extras.get("telemetry")
+    if tel is not None:
+        report["telemetry"] = tel["totals"]
     rr = result.extras.get("run_report")
     if rr is not None:
+        report_holder["run_report"] = rr
         report["attempts"] = rr["n_attempts"]
         report["resumed_from_round"] = rr["resumed_from_round"]
         report["fallback_used"] = rr["fallback_used"]
         if rr["fallback_used"]:
             report["platform"] = "oracle"
+    if args.verbose:
+        _print_verbose(result)
     print(json.dumps(report))
     return 0
 

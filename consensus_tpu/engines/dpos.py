@@ -63,7 +63,18 @@ def _producer_delivery(cfg: Config, seed, r, p):
     return ok & (v_idx != up)  # self handled separately
 
 
-def dpos_round(cfg: Config, producers, st: DposState, r) -> DposState:
+# On-device protocol telemetry (docs/OBSERVABILITY.md). "missed_appends"
+# counts validators that failed to extend their chain this round for ANY
+# reason (drop, partition, churn, full chain); "churn_slots" counts the
+# rounds whose production slot was skipped entirely.
+DPOS_TELEMETRY = ("blocks_appended",     # validator-chain extensions
+                  "missed_appends",      # validators not extended
+                  "producer_rotations",  # slot handoffs p_{r-1} != p_r
+                  "churn_slots")         # rounds churned (no block)
+
+
+def dpos_round(cfg: Config, producers, st: DposState, r, *,
+               telem: bool = False):
     V, L = cfg.n_nodes, cfg.log_capacity
     seed = st.seed
     e = r // cfg.epoch_len
@@ -82,7 +93,17 @@ def dpos_round(cfg: Config, producers, st: DposState, r) -> DposState:
                         st.chain_r)
     chain_p = jnp.where(slot_hot, p.astype(st.chain_p.dtype), st.chain_p)
     chain_len = st.chain_len + append.astype(jnp.int32)
-    return DposState(seed, chain_r, chain_p, chain_len)
+    new = DposState(seed, chain_r, chain_p, chain_len)
+    if not telem:
+        return new
+    rp = jnp.maximum(r - 1, 0)  # previous slot's producer (r=0: no handoff)
+    p_prev = producers[rp // cfg.epoch_len,
+                       (rp % cfg.epoch_len) % cfg.n_producers]
+    n_app = jnp.sum(append.astype(jnp.int32))
+    vec = jnp.stack([n_app, jnp.int32(V) - n_app,
+                     ((r > 0) & (p != p_prev)).astype(jnp.int32),
+                     churn.astype(jnp.int32)])
+    return new, vec
 
 
 def dpos_make_carry(cfg: Config, seed):
@@ -104,6 +125,12 @@ def dpos_make_carry(cfg: Config, seed):
 def dpos_round_carry(cfg: Config, carry, r):
     producers, st = carry
     return producers, dpos_round(cfg, producers, st, r)
+
+
+def dpos_round_carry_telem(cfg: Config, carry, r):
+    producers, st = carry
+    new, vec = dpos_round(cfg, producers, st, r, telem=True)
+    return (producers, new), vec
 
 
 def _dpos_extract(carry) -> dict:
@@ -130,7 +157,9 @@ def get_engine():
     if _ENGINE is None:
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("dpos", dpos_make_carry, dpos_round_carry,
-                            _dpos_extract, _dpos_pspec)
+                            _dpos_extract, _dpos_pspec,
+                            telemetry_names=DPOS_TELEMETRY,
+                            round_telem=dpos_round_carry_telem)
     return _ENGINE
 
 

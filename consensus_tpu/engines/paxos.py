@@ -43,7 +43,18 @@ def paxos_init(cfg: Config, seed) -> PaxosState:
                       jnp.zeros((N, S), bool))
 
 
-def paxos_round(cfg: Config, st: PaxosState, r) -> PaxosState:
+# On-device protocol telemetry (docs/OBSERVABILITY.md). "nacks" counts
+# prepares that were delivered AND whose response would have been
+# delivered, but whose ballot lost to an already-promised higher one —
+# the synchronous-round analog of an explicit reject message.
+PAXOS_TELEMETRY = ("promises",           # promise responses delivered
+                   "nacks",              # delivered prepares outbid
+                   "accepts",            # accepted responses delivered
+                   "proposals_decided",  # proposers reaching majority
+                   "values_learned")     # (node, slot) newly learned
+
+
+def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False):
     N, S = cfg.n_nodes, cfg.log_capacity
     P = cfg.n_proposers or N
     majority = N // 2 + 1
@@ -136,7 +147,19 @@ def paxos_round(cfg: Config, st: PaxosState, r) -> PaxosState:
     learned_val = jnp.where(learn_now, lv_in, st.learned_val)
     learned_mask = st.learned_mask | found
 
-    return PaxosState(seed, promised2, acc_bal2, acc_val2, learned_val, learned_mask)
+    new = PaxosState(seed, promised2, acc_bal2, acc_val2, learned_val,
+                     learned_mask)
+    if not telem:
+        return new
+    cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    nack = is_prop[None, :] & prep_del & resp_del & ~prom
+    vec = jnp.stack([cnt(prom), cnt(nack), cnt(accd), cnt(decided),
+                     cnt(learn_now)])
+    return new, vec
+
+
+def paxos_round_telem(cfg: Config, st: PaxosState, r):
+    return paxos_round(cfg, st, r, telem=True)
 
 
 def _paxos_extract(st: PaxosState) -> dict:
@@ -161,7 +184,8 @@ def get_engine():
     if _ENGINE is None:
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("paxos", paxos_init, paxos_round, _paxos_extract,
-                            _paxos_pspec)
+                            _paxos_pspec, telemetry_names=PAXOS_TELEMETRY,
+                            round_telem=paxos_round_telem)
     return _ENGINE
 
 

@@ -82,7 +82,19 @@ def pbft_init(cfg: Config, seed) -> PbftState:
     return PbftState(jnp.asarray(seed, jnp.uint32), z, z, bs, zs, zs, bs, bs, zs)
 
 
-def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
+# On-device protocol telemetry (docs/OBSERVABILITY.md): the per-phase
+# counters "Towards Improving the Performance of BFT Consensus"
+# (PAPERS.md) builds its evaluation on. Reduced from the round's own
+# tallies; never fed back into state (digest-neutral).
+PBFT_TELEMETRY = ("prepare_quorums",   # (node, slot) newly prepared
+                  "prepare_missed",    # seen, unprepared, tally < Q
+                  "commit_quorums",    # committed via own 2f+1 tally
+                  "commit_missed",     # prepared, uncommitted, tally < Q
+                  "commits_adopted",   # committed via decide gossip
+                  "view_changes")      # Σ per-node view advance
+
+
+def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     N, S = cfg.n_nodes, cfg.log_capacity
     f = cfg.f
     Q = 2 * f + 1
@@ -177,7 +189,10 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
         extra = jnp.sum(deliver & byz[:, None] & sup, axis=0,
                         dtype=jnp.int32)                               # [j]
         pcount = pcount + extra[:, None]
-    prepared = prepared | (pp_seen & (pcount >= Q))
+    prep_hit = pp_seen & (pcount >= Q)
+    prep_new = prep_hit & ~prepared        # telemetry (DCE'd when off)
+    prep_miss = pp_seen & ~prepared & ~prep_hit
+    prepared = prepared | prep_hit
 
     # ---- P5 commit tally.
     ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
@@ -185,6 +200,7 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
     if equiv:
         ccount = ccount + extra[:, None]
     commit_now = prepared & (ccount >= Q) & ~committed
+    commit_miss = prepared & ~committed & (ccount < Q)  # telemetry
     dval = jnp.where(commit_now, pp_val, dval)
     committed = committed | commit_now
 
@@ -201,8 +217,19 @@ def pbft_round(cfg: Config, st: PbftState, r) -> PbftState:
     timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
                       timer + 1)
 
-    return PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
-                     prepared, committed, dval)
+    new = PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
+                    prepared, committed, dval)
+    if not telem:
+        return new
+    cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
+                     cnt(commit_miss), cnt(adopt),
+                     jnp.sum(view - st.view)])
+    return new, vec
+
+
+def pbft_round_telem(cfg: Config, st: PbftState, r):
+    return pbft_round(cfg, st, r, telem=True)
 
 
 def _pbft_extract(st: PbftState) -> dict:
@@ -226,7 +253,8 @@ def get_engine():
     if _ENGINE is None:
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("pbft", pbft_init, pbft_round, _pbft_extract,
-                            _pbft_pspec)
+                            _pbft_pspec, telemetry_names=PBFT_TELEMETRY,
+                            round_telem=pbft_round_telem)
     return _ENGINE
 
 

@@ -44,7 +44,7 @@ from ..core.config import Config
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import bitcast_i32 as _i32
-from .pbft import PbftState, pbft_init
+from .pbft import PBFT_TELEMETRY, PbftState, pbft_init
 
 I32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -117,7 +117,7 @@ class _SortedTally:
         return out.T
 
 
-def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
+def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     N, S = cfg.n_nodes, cfg.log_capacity
     f = cfg.f
     Q = 2 * f + 1
@@ -285,12 +285,19 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
         self_adj = (honest_s & relevant_s & ~bcast_s).astype(jnp.int32)
         return cnt + self_adj + extra_s
 
-    # ---- P4 prepare tally.
-    prepared2_s = prepared_s | (pp_seen_s & (counts_for_s(pp_seen_s) >= Q))
+    # ---- P4 prepare tally. (Telemetry masks are computed in SORTED
+    # order — their jnp.sum totals are permutation-invariant, so no
+    # extra unsort payload is ever needed for them.)
+    c4 = counts_for_s(pp_seen_s)
+    prep_hit_s = pp_seen_s & (c4 >= Q)
+    prep_new_s = prep_hit_s & ~prepared_s       # telemetry (DCE'd when off)
+    prep_miss_s = pp_seen_s & ~prepared_s & ~prep_hit_s
+    prepared2_s = prepared_s | prep_hit_s
 
     # ---- P5 commit tally.
-    commit_now_s = (prepared2_s & (counts_for_s(prepared2_s) >= Q)
-                    & ~committed_s)
+    c5 = counts_for_s(prepared2_s)
+    commit_now_s = prepared2_s & (c5 >= Q) & ~committed_s
+    commit_miss_s = prepared2_s & ~committed_s & (c5 < Q)  # telemetry
 
     packed = tal.unsort(b32(prepared2_s) | (b32(commit_now_s) << 1))
     prepared = (packed & 1).astype(bool)
@@ -329,8 +336,19 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r) -> PbftState:
     timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
                       timer + 1)
 
-    return PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
-                     prepared, committed, dval)
+    new = PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
+                    prepared, committed, dval)
+    if not telem:
+        return new
+    cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    vec = jnp.stack([cnt(prep_new_s), cnt(prep_miss_s), cnt(commit_now_s),
+                     cnt(commit_miss_s), cnt(adopt),
+                     jnp.sum(view - st.view)])
+    return new, vec
+
+
+def pbft_bcast_round_telem(cfg: Config, st: PbftState, r):
+    return pbft_bcast_round(cfg, st, r, telem=True)
 
 
 def _extract(st: PbftState) -> dict:
@@ -355,5 +373,6 @@ def get_engine():
     if _ENGINE is None:
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("pbft-bcast", pbft_init, pbft_bcast_round,
-                            _extract, _pspec)
+                            _extract, _pspec, telemetry_names=PBFT_TELEMETRY,
+                            round_telem=pbft_bcast_round_telem)
     return _ENGINE

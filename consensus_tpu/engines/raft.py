@@ -145,8 +145,22 @@ def _last_term(log_term, log_len):
     return jnp.where(log_len > 0, _pick1(log_term, k), 0)
 
 
-def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
-    """One SPEC §3 round. `cfg` static; `r` traced i32 scalar."""
+# On-device protocol telemetry (docs/OBSERVABILITY.md): per-round i32
+# counters reduced from the round's own intermediates, in this order.
+# Never fed back into state — enabling them is digest-neutral.
+RAFT_TELEMETRY = ("leader_elections",    # candidates winning this round
+                  "append_accepted",     # AppendEntries applied (log match)
+                  "append_rejected",     # AppendEntries refused (mismatch)
+                  "entries_committed")   # Σ per-node commit-index advance
+
+
+def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False):
+    """One SPEC §3 round. `cfg` static; `r` traced i32 scalar.
+
+    ``telem=True`` additionally returns the :data:`RAFT_TELEMETRY`
+    vector; the state computation is the identical trace either way
+    (the counters read intermediates, XLA dead-code-eliminates them
+    when unused)."""
     N, L = cfg.n_nodes, cfg.log_capacity
     E = min(cfg.max_entries, L)
     majority = N // 2 + 1
@@ -288,6 +302,7 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
                             _pick1(log_term, kprev), 0)
     ok = (prev == 0) | ((prev <= log_len) & (own_at_prev == prev_term_l))
     apply_ = has_l & ok
+    append_rej = has_l & ~ok  # telemetry; DCE'd when telem is off
 
     l_len = _pick_row(s_len, ls)
     karange = jnp.arange(L, dtype=jnp.int32)[None, :]
@@ -351,8 +366,21 @@ def raft_round(cfg: Config, st: RaftState, r) -> RaftState:
     # ---- P4 timers.
     timer = jnp.where(role == ROLE_L, 0, jnp.where(reset, timer, timer + 1))
 
-    return RaftState(seed, term, role, voted_for, log_term, log_val, log_len,
-                     commit, timer, timeout, match_idx, next_idx)
+    new = RaftState(seed, term, role, voted_for, log_term, log_val, log_len,
+                    commit, timer, timeout, match_idx, next_idx)
+    if not telem:
+        return new
+    vec = jnp.stack([jnp.sum(win.astype(jnp.int32)),
+                     jnp.sum(apply_.astype(jnp.int32)),
+                     jnp.sum(append_rej.astype(jnp.int32)),
+                     jnp.sum(commit - st.commit)])
+    return new, vec
+
+
+def raft_round_telem(cfg: Config, st: RaftState, r):
+    """EngineDef.round_telem entry — a stable named function (a
+    functools.partial would hash by identity and fragment jit caches)."""
+    return raft_round(cfg, st, r, telem=True)
 
 
 def _raft_extract(st: RaftState) -> dict:
@@ -377,7 +405,8 @@ def get_engine():
     if _ENGINE is None:
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("raft", raft_init, raft_round, _raft_extract,
-                            _raft_pspec)
+                            _raft_pspec, telemetry_names=RAFT_TELEMETRY,
+                            round_telem=raft_round_telem)
     return _ENGINE
 
 

@@ -41,8 +41,8 @@ from ..ops.adversary import bitcast_i32 as _i32
 from ..ops.adversary import delivery_edges as _edges
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
-from .raft import (NONE, ROLE_C, ROLE_F, ROLE_L, _draw_timeout, _last_term,
-                   _match_dtype, _pick1, _pick_row)
+from .raft import (NONE, RAFT_TELEMETRY, ROLE_C, ROLE_F, ROLE_L,
+                   _draw_timeout, _last_term, _match_dtype, _pick1, _pick_row)
 
 
 def _rows_from_small(small, rsel):
@@ -110,9 +110,14 @@ def _top_active(mask, term, idx, A: int):
     return jnp.where(key_sorted[:A] != I32_MAX, ids_sorted[:A], NONE)
 
 
-def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
+def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
+                      telem: bool = False):
     """One SPEC §3 round under the §3b active-sender cap. Mirrors the dense
-    kernel phase by phase; every dense [N, N] object becomes [A, N]/[N, A]."""
+    kernel phase by phase; every dense [N, N] object becomes [A, N]/[N, A].
+    ``telem=True`` additionally returns the shared :data:`RAFT_TELEMETRY`
+    counter vector (same semantics as the dense kernel's — elections are
+    counted over the tracked candidate set, which under the §3b cap is
+    the only set that can win)."""
     N, L, A = cfg.n_nodes, cfg.log_capacity, cfg.max_active
     E = min(cfg.max_entries, L)
     majority = N // 2 + 1
@@ -277,6 +282,7 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
                             _pick1(log_term, kprev), 0)
     ok = (prev == 0) | ((prev <= log_len) & (own_at_prev == prev_term_l))
     apply_ = has_l & ok
+    append_rej = has_l & ~ok  # telemetry; DCE'd when telem is off
 
     l_len = _pick_row(s_len, kstar)
     copy_mask = apply_[:, None] & (karange >= prev[:, None]) \
@@ -345,9 +351,20 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r) -> RaftSparseState:
     # ---- P4 timers.
     timer = jnp.where(role == ROLE_L, 0, jnp.where(reset, timer, timer + 1))
 
-    return RaftSparseState(seed, term, role, voted_for, log_term, log_val,
-                           log_len, commit, timer, timeout, lead_id,
-                           lead_match, lead_next)
+    new = RaftSparseState(seed, term, role, voted_for, log_term, log_val,
+                          log_len, commit, timer, timeout, lead_id,
+                          lead_match, lead_next)
+    if not telem:
+        return new
+    vec = jnp.stack([jnp.sum(win.astype(jnp.int32)),
+                     jnp.sum(apply_.astype(jnp.int32)),
+                     jnp.sum(append_rej.astype(jnp.int32)),
+                     jnp.sum(commit - st.commit)])
+    return new, vec
+
+
+def raft_sparse_round_telem(cfg: Config, st: RaftSparseState, r):
+    return raft_sparse_round(cfg, st, r, telem=True)
 
 
 def _extract(st: RaftSparseState) -> dict:
@@ -373,5 +390,6 @@ def get_engine():
     if _ENGINE is None:
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("raft-sparse", raft_sparse_init, raft_sparse_round,
-                            _extract, _pspec)
+                            _extract, _pspec, telemetry_names=RAFT_TELEMETRY,
+                            round_telem=raft_sparse_round_telem)
     return _ENGINE

@@ -29,6 +29,7 @@ import functools
 import json
 import pathlib
 import sys
+import time
 import zipfile
 import zlib
 from typing import Any, Callable
@@ -38,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.config import Config
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..parallel import mesh as meshlib
 from . import faults
 
@@ -50,12 +53,22 @@ class EngineDef:
     round_fn(cfg, carry, r) -> carry  # one round; pure; r = absolute round
     extract(batched_carry) -> dict[str, np.ndarray]
     carry_pspec(cfg) -> pytree of PartitionSpec matching the unbatched carry
+
+    Optional on-device telemetry (docs/OBSERVABILITY.md §"Telemetry"):
+    round_telem(cfg, carry, r) -> (carry, i32[K]) runs the SAME state
+    update as round_fn plus a K-vector of per-round protocol counters
+    (K = len(telemetry_names)) reduced from the round's intermediates.
+    The vector is accumulated across the scan alongside the carry and
+    never feeds back into state, so enabling it is digest-neutral by
+    construction (tests/test_obs.py proves bit-identity per engine).
     """
     name: str
     make_carry: Callable[..., Any]
     round_fn: Callable[..., Any]
     extract: Callable[[Any], dict]
     carry_pspec: Callable[[Config], Any]
+    telemetry_names: tuple = ()
+    round_telem: Callable[..., Any] | None = None
 
 
 def make_seeds(cfg: Config) -> np.ndarray:
@@ -71,7 +84,8 @@ def _init_jit(cfg: Config, eng: EngineDef, seeds, *, mesh=None):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("mesh",))
-def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0, *, mesh=None):
+def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0,
+               telem=None, *, mesh=None):
     """Advance the batched carry by ``n_rounds`` rounds starting at ``r0``.
 
     The round body must stay inside a scan of length >= 2: XLA unrolls a
@@ -80,30 +94,51 @@ def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0, *, mesh=No
     body that compiles in ~2s inside a while loop — measured 2026-07-29).
     A 1-round chunk therefore scans a masked pair: round r0, then a
     dead lane whose output is discarded leaf-wise.
+
+    ``telem`` (optional, [B, K] i32) switches the scan body to
+    ``eng.round_telem`` and rides the scan carry as a running per-sweep
+    counter accumulator; the return becomes ``(carry, telem)``. With
+    ``telem=None`` (default) the call and return shapes are unchanged —
+    the callers predating telemetry (tests, __graft_entry__) keep
+    working verbatim, and the no-telemetry program is byte-for-byte the
+    pre-telemetry one (nothing new is traced).
     """
     pspec = eng.carry_pspec(cfg)
+    telemetry = telem is not None
     # Only the padded 1-round chunk needs the dead-lane select; for real
     # chunks every scan step is live, and a full-carry jnp.where per round
     # costs measurable HBM traffic (bench.py ran ~25% under the bare
     # kernel before this was made conditional).
     masked = n_rounds == 1
 
-    def body(c, ra):
+    def body(ct, ra):
+        c, t = ct
         if masked:
             r, active = ra
         else:
             r = ra
-        new = jax.vmap(lambda s: eng.round_fn(cfg, s, r))(c)
+        if telemetry:
+            new, d = jax.vmap(lambda s: eng.round_telem(cfg, s, r))(c)
+            if masked:  # the dead lane must not double-count
+                d = jnp.where(active, d, jnp.zeros_like(d))
+            t = t + d
+            if mesh is not None:
+                t = jax.lax.with_sharding_constraint(
+                    t, jax.sharding.NamedSharding(
+                        mesh, jax.sharding.PartitionSpec(
+                            meshlib.SWEEP_AXIS, None)))
+        else:
+            new = jax.vmap(lambda s: eng.round_fn(cfg, s, r))(c)
         if masked:
             new = jax.tree.map(lambda a, b: jnp.where(active, a, b), new, c)
-        return meshlib.constrain(new, cfg, mesh, pspec), None
+        return (meshlib.constrain(new, cfg, mesh, pspec), t), None
 
     if masked:
         xs = (jnp.stack([r0, r0]), jnp.asarray([True, False]))
     else:
         xs = r0 + jnp.arange(n_rounds, dtype=jnp.int32)
-    carry, _ = jax.lax.scan(body, carry, xs)
-    return carry
+    (carry, telem), _ = jax.lax.scan(body, (carry, telem), xs)
+    return (carry, telem) if telemetry else carry
 
 
 @jax.jit
@@ -180,7 +215,7 @@ def checkpoint_candidates(path) -> list:
 
 
 def save_checkpoint(path, cfg: Config, carry, next_round: int,
-                    seeds=None, keep: int = 1) -> None:
+                    seeds=None, keep: int = 1) -> dict:
     """Snapshot the batched carry after ``next_round`` rounds have run.
 
     ``seeds`` records the per-sweep seed vector the carry was produced
@@ -193,31 +228,48 @@ def save_checkpoint(path, cfg: Config, carry, next_round: int,
     dropped). Every step is a single rename, so a kill at any point
     leaves only whole files — recovery never sees a half-rotated state
     worse than one missing rung.
+
+    Returns ``{"bytes": npz_size, "wall_s": duration}`` — the concrete
+    "measure first" numbers the ROADMAP's async-checkpoint item needs
+    (also recorded as metrics and, via the runner, in
+    ``RunResult.extras["checkpoint_io"]``).
     """
     if keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
-    leaves, _ = jax.tree.flatten(carry)
-    arrays = {f"leaf_{i}": np.ascontiguousarray(x)
-              for i, x in enumerate(leaves)}
-    path = pathlib.Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    tmp = path.with_suffix(".tmp.npz")
-    seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
-    config = json.loads(cfg.to_json())
-    seed_list = [int(s) for s in seeds]
-    leaf_crc32 = [_leaf_crc(arrays[f"leaf_{i}"]) for i in range(len(leaves))]
-    meta = {"config": config, "next_round": next_round, "seeds": seed_list,
-            "integrity": {
-                "leaf_crc32": leaf_crc32,
-                "manifest_crc32": _manifest_crc(config, next_round,
-                                                seed_list, leaf_crc32)}}
-    np.savez(tmp, __meta__=np.frombuffer(json.dumps(meta).encode(),
-                                         dtype=np.uint8), **arrays)
-    for i in range(keep - 1, 0, -1):
-        src = rotation_path(path, i - 1)
-        if src.exists():
-            src.replace(rotation_path(path, i))
-    tmp.replace(path)
+    t0 = time.perf_counter()
+    with obs_trace.span("checkpoint_save", next_round=next_round) as sp:
+        leaves, _ = jax.tree.flatten(carry)
+        arrays = {f"leaf_{i}": np.ascontiguousarray(x)
+                  for i, x in enumerate(leaves)}
+        path = pathlib.Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp.npz")
+        seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
+        config = json.loads(cfg.to_json())
+        seed_list = [int(s) for s in seeds]
+        leaf_crc32 = [_leaf_crc(arrays[f"leaf_{i}"])
+                      for i in range(len(leaves))]
+        meta = {"config": config, "next_round": next_round,
+                "seeds": seed_list,
+                "integrity": {
+                    "leaf_crc32": leaf_crc32,
+                    "manifest_crc32": _manifest_crc(config, next_round,
+                                                    seed_list, leaf_crc32)}}
+        np.savez(tmp, __meta__=np.frombuffer(json.dumps(meta).encode(),
+                                             dtype=np.uint8), **arrays)
+        nbytes = tmp.stat().st_size
+        for i in range(keep - 1, 0, -1):
+            src = rotation_path(path, i - 1)
+            if src.exists():
+                src.replace(rotation_path(path, i))
+        tmp.replace(path)
+        if sp is not None:
+            sp["bytes"] = nbytes
+    wall = time.perf_counter() - t0
+    obs_metrics.counter("checkpoint_saves_total").inc()
+    obs_metrics.counter("checkpoint_bytes_written_total").inc(nbytes)
+    obs_metrics.histogram("checkpoint_save_s").observe(wall)
+    return {"bytes": nbytes, "wall_s": wall}
 
 
 def _read_verified(path):
@@ -293,7 +345,8 @@ def _scan_valid(path, cfg: Config, seeds):
             yield cand, meta, leaves
 
 
-def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None):
+def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None, *,
+                    io: dict | None = None):
     """Return (carry, next_round) from the newest VALID snapshot of
     ``path`` — or None when no rotation is both intact and matching.
 
@@ -306,23 +359,41 @@ def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None):
     A torn/corrupted rotation (checksum or container failure) is
     skipped with a warning and the next-oldest is tried: recovery costs
     one rotation of progress, never the whole run.
+
+    ``io`` (optional dict with loads/load_s/bytes_read keys) accumulates
+    the wall time and npz byte size of a successful load — the
+    checkpoint-IO record surfaced via ``RunResult.extras``.
     """
-    for cand, meta, leaves in _scan_valid(path, cfg, seeds):
-        if cand != pathlib.Path(path):
-            _log_ckpt(f"recovered from rotation {cand} "
-                      f"(round {meta['next_round']})")
-        template = jax.eval_shape(
-            lambda s: _init_template(cfg, eng, s),
-            jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32))
-        # Cast to the template dtypes: an engine may narrow a state
-        # field's storage dtype between versions (e.g. raft match/next
-        # i32 -> u8); the saved integer values are identical, but
-        # lax.scan requires the carry dtype to match what round_fn
-        # returns.
-        leaves = [np.asarray(leaf).astype(t.dtype)
-                  for leaf, t in zip(leaves, jax.tree.leaves(template))]
-        treedef = jax.tree.structure(template)
-        return jax.tree.unflatten(treedef, leaves), meta["next_round"]
+    t0 = time.perf_counter()
+    with obs_trace.span("checkpoint_load") as sp:
+        for cand, meta, leaves in _scan_valid(path, cfg, seeds):
+            if cand != pathlib.Path(path):
+                _log_ckpt(f"recovered from rotation {cand} "
+                          f"(round {meta['next_round']})")
+            template = jax.eval_shape(
+                lambda s: _init_template(cfg, eng, s),
+                jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32))
+            # Cast to the template dtypes: an engine may narrow a state
+            # field's storage dtype between versions (e.g. raft match/next
+            # i32 -> u8); the saved integer values are identical, but
+            # lax.scan requires the carry dtype to match what round_fn
+            # returns.
+            leaves = [np.asarray(leaf).astype(t.dtype)
+                      for leaf, t in zip(leaves, jax.tree.leaves(template))]
+            treedef = jax.tree.structure(template)
+            nbytes = cand.stat().st_size
+            wall = time.perf_counter() - t0
+            if sp is not None:
+                sp["bytes"] = nbytes
+                sp["next_round"] = meta["next_round"]
+            obs_metrics.counter("checkpoint_loads_total").inc()
+            obs_metrics.counter("checkpoint_bytes_read_total").inc(nbytes)
+            obs_metrics.histogram("checkpoint_load_s").observe(wall)
+            if io is not None:
+                io["loads"] += 1
+                io["load_s"] += wall
+                io["bytes_read"] += nbytes
+            return jax.tree.unflatten(treedef, leaves), meta["next_round"]
     return None
 
 
@@ -409,25 +480,48 @@ def _prepare(cfg: Config, eng: EngineDef, mesh, seeds=None):
 
 
 def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
-             mesh, checkpoint_path=None, seeds=None, keep: int = 1):
+             mesh, checkpoint_path=None, seeds=None, keep: int = 1,
+             telem=None, io: dict | None = None):
     """Drive fixed-shape jitted chunks from ``start`` to ``cfg.n_rounds``.
+    Returns ``(carry, telem)`` — ``telem`` is the accumulated [B, K]
+    telemetry counters, or None when telemetry is off.
 
     The two ``faults`` hooks are the crash-injection harness's seams
     (one ``is None`` check each when no plan is installed): a transient
     error fires BEFORE a chunk dispatches; a kill fires AFTER a chunk
     completes and its checkpoint (if any) is durably on disk.
+
+    Each chunk dispatch is traced as a "dispatch" span and fed into the
+    ``dispatch_wall_s`` histogram. The measured quantity is the HOST
+    time inside the dispatch call — on an async backend device work may
+    continue past it; any subsequent checkpoint save (a device→host
+    pull) absorbs the remainder, which is exactly the dispatch-vs-IO
+    split the ROADMAP's async-writer decision needs.
     """
     r = start
     while r < cfg.n_rounds:
         faults.on_dispatch()
         n = min(chunk, cfg.n_rounds - r)
-        carry = _chunk_jit(cfg, eng, n, carry, jnp.int32(r), mesh=mesh)
+        t0 = time.perf_counter()
+        with obs_trace.span("dispatch", engine=eng.name, r0=r, n_rounds=n):
+            if telem is None:
+                carry = _chunk_jit(cfg, eng, n, carry, jnp.int32(r),
+                                   mesh=mesh)
+            else:
+                carry, telem = _chunk_jit(cfg, eng, n, carry, jnp.int32(r),
+                                          telem, mesh=mesh)
+        obs_metrics.histogram("dispatch_wall_s").observe(
+            time.perf_counter() - t0)
         r += n
         if checkpoint_path and r < cfg.n_rounds:
-            save_checkpoint(checkpoint_path, cfg, carry, r, seeds=seeds,
-                            keep=keep)
+            rec = save_checkpoint(checkpoint_path, cfg, carry, r,
+                                  seeds=seeds, keep=keep)
+            if io is not None:
+                io["saves"] += 1
+                io["save_s"] += rec["wall_s"]
+                io["bytes_written"] += rec["bytes"]
         faults.on_chunk_end()
-    return carry
+    return carry, telem
 
 
 def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
@@ -453,7 +547,8 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
         return carry
     mesh, seeds = _prepare(cfg, eng, mesh, seeds)
     carry = _init_jit(cfg, eng, seeds, mesh=mesh)
-    carry = _advance(cfg, eng, carry, 0, cfg.scan_chunk or cfg.n_rounds, mesh)
+    carry, _ = _advance(cfg, eng, carry, 0, cfg.scan_chunk or cfg.n_rounds,
+                        mesh)
     # Sync barrier, O(1) bytes: transfer a jitted 1-element slice of a
     # final-carry leaf. The slice program has a data dependency on the
     # whole round loop, so its 4-byte result reaching the host proves
@@ -467,9 +562,15 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
     return carry
 
 
+def _empty_io() -> dict:
+    return {"saves": 0, "save_s": 0.0, "bytes_written": 0,
+            "loads": 0, "load_s": 0.0, "bytes_read": 0}
+
+
 def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         resume: bool = False, stats: dict | None = None,
-        seeds=None, keep_checkpoints: int = 2) -> dict:
+        seeds=None, keep_checkpoints: int = 2,
+        telemetry: bool = False) -> dict:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
@@ -483,7 +584,25 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     ``executed_rounds`` so callers can report throughput for the rounds
     this call actually ran (a resumed run skips the first
     ``start_round`` rounds — counting them would inflate steps/sec).
+    A checkpointing run additionally fills ``stats["checkpoint_io"]``
+    (save/load counts, wall seconds, npz bytes — recorded even when
+    tracing is off; the ROADMAP's "measure first" datum).
+
+    ``telemetry=True`` accumulates the engine's on-device protocol
+    counters (``eng.telemetry_names``) alongside the carry and fills
+    ``stats["telemetry"] = {name: i64[n_sweeps]}``. Digest-neutral by
+    construction: the counters are reduced from the same state update
+    and never feed back into it (docs/OBSERVABILITY.md). The counters
+    cover the rounds THIS process executed — a resumed run restarts
+    them at zero, mirroring ``executed_rounds``; they are deliberately
+    not checkpointed (the snapshot format stays telemetry-agnostic).
     """
+    if telemetry and eng.round_telem is None:
+        raise ValueError(f"engine {eng.name!r} provides no telemetry "
+                         "counters (EngineDef.round_telem is None)")
+    if telemetry and stats is None:
+        raise ValueError("telemetry=True needs a stats dict to receive "
+                         "the counters (stats['telemetry'])")
     groups = _sweep_groups(cfg, seeds)
     if groups is not None:
         mesh = _check_groups(cfg, groups, mesh)
@@ -494,16 +613,29 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
             raise ValueError("checkpointing is not supported with "
                              "sweep_chunk; use scan_chunk for mid-run "
                              "snapshots or sweep_chunk=0")
-        outs = [run(sub, eng, mesh=mesh, stats=stats, seeds=s)
-                for sub, s in groups]
+        outs, telems = [], []
+        for sub, s in groups:
+            gstats: dict = {}
+            outs.append(run(sub, eng, mesh=mesh, stats=gstats, seeds=s,
+                            telemetry=telemetry))
+            if telemetry:
+                telems.append(gstats.pop("telemetry"))
+            if stats is not None:
+                stats.update(gstats)
+        if telemetry:
+            stats["telemetry"] = {
+                k: np.concatenate([t[k] for t in telems])
+                for k in telems[0]}
         return {k: np.concatenate([o[k] for o in outs], axis=0)
                 for k in outs[0]}
     mesh, seeds = _prepare(cfg, eng, mesh, seeds)
 
+    io = _empty_io() if checkpoint_path else None
     start = 0
     carry = None
     if resume and checkpoint_path:
-        loaded = load_checkpoint(checkpoint_path, cfg, eng, seeds=seeds)
+        loaded = load_checkpoint(checkpoint_path, cfg, eng, seeds=seeds,
+                                 io=io)
         if loaded is not None:
             carry, start = loaded
             carry = jax.device_put(carry)
@@ -528,10 +660,22 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     # and re-verifying the snapshot it just loaded.
     if stats is not None:
         stats["start_round"] = start
-    carry = _advance(cfg, eng, carry, start, chunk, mesh, checkpoint_path,
-                     seeds=np.asarray(seeds), keep=keep_checkpoints)
+    telem = (jnp.zeros((cfg.n_sweeps, len(eng.telemetry_names)), jnp.int32)
+             if telemetry else None)
+    carry, telem = _advance(cfg, eng, carry, start, chunk, mesh,
+                            checkpoint_path, seeds=np.asarray(seeds),
+                            keep=keep_checkpoints, telem=telem, io=io)
 
     if stats is not None:
         stats["executed_rounds"] = cfg.n_rounds - start
+        if io is not None:
+            stats["checkpoint_io"] = io
+        if telemetry:
+            # int64 on host: per-round deltas are i32-safe, but a long
+            # run's accumulation should be summed/reported unclamped.
+            tarr = np.asarray(telem).astype(np.int64)
+            stats["telemetry"] = {
+                name: tarr[:, k]
+                for k, name in enumerate(eng.telemetry_names)}
 
     return {k: np.asarray(v) for k, v in eng.extract(carry).items()}

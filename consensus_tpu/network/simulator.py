@@ -69,16 +69,27 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
     :func:`consensus_tpu.network.runner.run`."""
     executed_rounds = cfg.n_rounds
     timing_includes_compile = False
+    stats = None
     if cfg.engine == "tpu":
         # Honor a caller-provided stats dict (it is filled in place by
         # runner.run) instead of silently shadowing it with our own.
         kw = dict(engine_kw)
         if kw.get("stats") is None:
             kw["stats"] = {}
-        stats: dict = kw["stats"]
+        stats = kw["stats"]
         warm = warmup and not engine_kw.get("checkpoint_path")
         if warm:
-            _run_jax(cfg, **kw)  # compile; discard result
+            # Compile + warm; discard result. The pass's dispatches are
+            # EXCLUDED from metrics and trace — exported artifacts must
+            # measure the run, not jit tracing + XLA compilation (the
+            # benchmark suite resets its registry for the same reason).
+            # One "warmup" span (opened before the suspension, so it
+            # still records at close) covers the whole pass.
+            from ..obs import metrics as obs_metrics
+            from ..obs import trace as obs_trace
+            with obs_trace.span("warmup", protocol=cfg.protocol):
+                with obs_trace.suspended(), obs_metrics.paused():
+                    _run_jax(cfg, **kw)
         t0 = time.perf_counter()
         out = _run_jax(cfg, **kw)
         wall = time.perf_counter() - t0
@@ -90,14 +101,34 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
                 f"engine_kw {sorted(engine_kw)} only apply to the tpu "
                 f"engine; cfg.engine={cfg.engine!r} would silently ignore "
                 "them (mesh/checkpoint/resume are TPU-engine features)")
+        from ..obs import trace as obs_trace
         from ..oracle import bindings
         bindings.get_lib()  # build outside the timed window
         t0 = time.perf_counter()
-        out = _run_oracle(cfg)
+        with obs_trace.span("oracle_run", protocol=cfg.protocol,
+                            n_sweeps=cfg.n_sweeps):
+            out = _run_oracle(cfg)
         wall = time.perf_counter() - t0
 
     counts, rec_a, rec_b, payload = decided_payload(cfg, out)
     extras = {}
+    if stats is not None:
+        tstats = stats.get("telemetry")
+        if tstats is not None:
+            # Per-sweep counters reduced on device inside the scan body
+            # (docs/OBSERVABILITY.md §"Telemetry"); totals are the
+            # host-side sum over sweeps — the CLI-report shape.
+            extras["telemetry"] = {
+                "names": list(tstats),
+                "per_sweep": {k: np.asarray(v) for k, v in tstats.items()},
+                "totals": {k: int(np.asarray(v, dtype=np.int64).sum())
+                           for k, v in tstats.items()}}
+        io = stats.get("checkpoint_io")
+        if io is not None:
+            # Save/load wall time + npz bytes, recorded even with
+            # tracing off — the ROADMAP's async-writer "measure first"
+            # numbers (printed by the CLI at -v).
+            extras["checkpoint_io"] = dict(io)
     if cfg.protocol == "dpos":
         # For dpos the decided records ARE the chain (counts=chain_len,
         # rec_b=chain_p), so `lib` derives uniformly for either engine.

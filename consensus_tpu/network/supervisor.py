@@ -32,9 +32,12 @@ supervised run's digest is bit-identical to an uninterrupted one
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
 
 from ..core.config import Config
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from . import faults, simulator
 
 
@@ -92,12 +95,18 @@ class RunReport:
         d["n_attempts"] = len(self.attempts)
         return d
 
+    def to_json(self, indent: int | None = 2) -> str:
+        """Canonical JSON form (per-attempt wall times included) — the
+        artifact the CLI writes next to ``--metrics-out``."""
+        return json.dumps(self.to_dict(), indent=indent)
+
 
 def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                    backoff_cap_s: float = 30.0, deadline_s: float | None = None,
                    fallback_cpu: bool = False, checkpoint_path=None,
                    keep_checkpoints: int = 2, mesh=None, seeds=None,
-                   warmup: bool = False, sleep=time.sleep):
+                   warmup: bool = False, telemetry: bool = False,
+                   sleep=time.sleep):
     """Run ``cfg`` under supervision; return the :class:`RunResult` with
     ``extras["run_report"]`` filled in.
 
@@ -115,6 +124,17 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     not steady-state timing, so the compile-then-rerun warmup of
     :func:`simulator.run` is skipped; ``RunResult.timing_includes_compile``
     is set accordingly.
+
+    ``telemetry=True`` enables the tpu engine's on-device protocol
+    counters (``RunResult.extras["telemetry"]``, docs/OBSERVABILITY.md).
+    A CPU-oracle fallback run carries no on-device telemetry — the
+    degraded result's extras simply lack the key, and
+    ``report.fallback_used`` says why.
+
+    Supervision itself is observable: each attempt runs inside a
+    ``supervised_attempt`` trace span, retries/backoffs emit events and
+    bump ``supervisor_retries_total``, and a fallback bumps
+    ``supervisor_fallbacks_total``.
     """
     if retries < 0:
         raise ValueError(f"retries must be >= 0, got {retries}")
@@ -130,6 +150,10 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     if checkpoint_path and cfg.engine != "tpu":
         raise ValueError("checkpoint_path is a tpu-engine feature "
                          f"(cfg.engine={cfg.engine!r})")
+    if telemetry and cfg.engine != "tpu":
+        raise ValueError("telemetry is reduced inside the tpu engine's "
+                         f"scan body (cfg.engine={cfg.engine!r} has no "
+                         "on-device counters)")
 
     report = RunReport(retries=retries)
     t_start = time.monotonic()
@@ -147,6 +171,8 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
         kw = {}
         if cfg.engine == "tpu":
             kw["stats"] = stats
+            if telemetry:
+                kw["telemetry"] = True
             if checkpoint_path:
                 kw.update(checkpoint_path=checkpoint_path, resume=True,
                           keep_checkpoints=keep_checkpoints)
@@ -156,7 +182,11 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                 kw["seeds"] = seeds
         t0 = time.monotonic()
         try:
-            result = simulator.run(cfg, warmup=warmup, **kw)
+            with obs_trace.span("supervised_attempt", index=attempt,
+                                engine=cfg.engine) as sp:
+                result = simulator.run(cfg, warmup=warmup, **kw)
+                if sp is not None:
+                    sp["start_round"] = stats.get("start_round", 0)
         except Exception as exc:  # noqa: BLE001 — classified below
             wall = time.monotonic() - t0
             if not is_transient(exc):
@@ -164,6 +194,10 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
             report.attempts.append(Attempt(attempt,
                                            stats.get("start_round", 0),
                                            wall, error=repr(exc)))
+            obs_metrics.counter("supervisor_retries_total").inc()
+            obs_trace.event("attempt_failed", index=attempt,
+                            start_round=stats.get("start_round", 0),
+                            error=repr(exc))
             last_exc = exc
             if attempt < retries:
                 delay = min(backoff_cap_s, backoff_s * (2 ** attempt))
@@ -171,6 +205,7 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                     delay = min(delay, max(
                         0.0, deadline_s - (time.monotonic() - t_start)))
                 if delay > 0:
+                    obs_trace.event("backoff", delay_s=delay)
                     sleep(delay)
             continue
         start_round = stats.get("start_round", 0)
@@ -186,8 +221,10 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
         # the caller still gets a correct result — just slowly. A fresh
         # run: the oracle has no checkpoint/resume surface.
         report.fallback_used = True
-        result = simulator.run(dataclasses.replace(cfg, engine="cpu"),
-                               warmup=False)
+        obs_metrics.counter("supervisor_fallbacks_total").inc()
+        with obs_trace.span("oracle_fallback", protocol=cfg.protocol):
+            result = simulator.run(dataclasses.replace(cfg, engine="cpu"),
+                                   warmup=False)
         result.extras["run_report"] = report.to_dict()
         return result
     why = ("wall-clock deadline exceeded" if report.deadline_exceeded

@@ -1,0 +1,25 @@
+"""Unified observability layer (docs/OBSERVABILITY.md).
+
+Three pillars, all opt-in and all digest-neutral by construction:
+
+  * :mod:`consensus_tpu.obs.trace`   — lightweight host-side spans/events
+    with monotonic timestamps, written as JSONL; optionally mirrored
+    into ``jax.profiler.TraceAnnotation`` so profiler traces line up
+    with our span boundaries.
+  * :mod:`consensus_tpu.obs.metrics` — a process-wide registry of
+    counters / gauges / histograms, snapshotable to JSON and renderable
+    as Prometheus text format.
+  * **on-device protocol telemetry** — per-round counter vectors reduced
+    inside each engine's scan body (leader elections, quorum hits,
+    promises/nacks, ...), surfaced through
+    ``RunResult.extras["telemetry"]``. That piece lives in the engines
+    and :mod:`consensus_tpu.network.runner`; this package holds only the
+    host-side sinks.
+
+Nothing here imports jax at module import time — the trace module
+touches ``jax.profiler`` lazily and only when profiler annotation was
+explicitly requested.
+"""
+from . import metrics, trace  # noqa: F401
+
+__all__ = ["metrics", "trace"]

@@ -1,0 +1,189 @@
+"""Process-wide metrics registry (docs/OBSERVABILITY.md §"Metrics").
+
+Counters, gauges and histograms with a JSON snapshot and a Prometheus
+text rendering. Recording is always on — one dict lookup plus an int add
+per observation, at O(chunks) call rates on the hot path — while
+*export* is opt-in (the CLI's ``--metrics-out``, the benchmark suite's
+per-row embedding).
+
+    from consensus_tpu.obs import metrics
+    metrics.counter("checkpoint_saves_total").inc()
+    metrics.histogram("dispatch_wall_s").observe(0.012)
+    metrics.snapshot()       # {name: {"type": ..., ...}}
+    metrics.to_prometheus()  # text exposition format
+
+Snapshot schema (version 1):
+
+  counter   : {"type": "counter", "value": number}
+  gauge     : {"type": "gauge", "value": number}
+  histogram : {"type": "histogram", "count": int, "sum": float,
+               "bounds": [b0 < b1 < ...], "counts": [c0, ..., c_n]}
+              — counts has len(bounds)+1 entries (last = overflow
+              bucket, observations > bounds[-1]); NON-cumulative, so
+              count == sum(counts). The Prometheus rendering converts
+              to cumulative le-buckets with the trailing +Inf.
+
+Tests (and the benchmark suite, which wants a per-config delta) use
+:func:`reset` to zero the default registry.
+"""
+from __future__ import annotations
+
+import bisect
+import contextlib
+import threading
+
+SCHEMA_VERSION = 1
+
+# Process-wide recording switch (see paused()). Checked by every
+# instrument so a warmup/compile pass can be excluded from the numbers
+# a run exports — one module-global read per observation.
+_PAUSED = False
+
+
+@contextlib.contextmanager
+def paused():
+    """Temporarily drop all observations (every registry in-process) —
+    used around warmup passes so exported histograms measure the run,
+    not jit tracing + XLA compilation (docs/OBSERVABILITY.md)."""
+    global _PAUSED
+    prev, _PAUSED = _PAUSED, True
+    try:
+        yield
+    finally:
+        _PAUSED = prev
+
+# Seconds-scale latency buckets: 100 µs .. 5 min, roughly log-spaced.
+# Wide on purpose — the same bounds serve a ~ms CPU-backend dispatch and
+# a multi-second 100k-node checkpoint write.
+DEFAULT_BUCKETS = (1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
+                   5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                   120.0, 300.0)
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, v=1) -> None:
+        if v < 0:
+            raise ValueError(f"counters only go up, got inc({v})")
+        if not _PAUSED:
+            self.value += v
+
+    def to_dict(self) -> dict:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Last-write-wins value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v) -> None:
+        if not _PAUSED:
+            self.value = v
+
+    def to_dict(self) -> dict:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Fixed-bucket histogram; ``counts[i]`` holds observations with
+    ``v <= bounds[i]`` (first matching bucket), the final slot overflow."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds or list(bounds) != sorted(set(bounds)):
+            raise ValueError(f"buckets must be strictly increasing, "
+                             f"got {buckets}")
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v) -> None:
+        if _PAUSED:
+            return
+        v = float(v)
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def to_dict(self) -> dict:
+        return {"type": "histogram", "count": self.count, "sum": self.sum,
+                "bounds": list(self.bounds), "counts": list(self.counts)}
+
+
+class Registry:
+    """Name → metric. Re-requesting a name returns the same instance;
+    requesting it as a different type is an error (no silent shadowing)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict = {}
+
+    def _get(self, name: str, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(*args)
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, Histogram, buckets)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {name: m.to_dict()
+                    for name, m in sorted(self._metrics.items())}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (cumulative le-buckets)."""
+        out = []
+        for name, d in self.snapshot().items():
+            out.append(f"# TYPE {name} {d['type']}")
+            if d["type"] in ("counter", "gauge"):
+                out.append(f"{name} {d['value']}")
+                continue
+            cum = 0
+            for bound, c in zip(d["bounds"], d["counts"]):
+                cum += c
+                out.append(f'{name}_bucket{{le="{bound}"}} {cum}')
+            out.append(f'{name}_bucket{{le="+Inf"}} {d["count"]}')
+            out.append(f"{name}_sum {d['sum']}")
+            out.append(f"{name}_count {d['count']}")
+        return "\n".join(out) + ("\n" if out else "")
+
+
+REGISTRY = Registry()
+
+# Module-level conveniences bound to the default registry — call sites
+# read `metrics.counter("x").inc()`.
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+reset = REGISTRY.reset
+snapshot = REGISTRY.snapshot
+to_prometheus = REGISTRY.to_prometheus

@@ -1,0 +1,169 @@
+"""Span tracing with a JSONL sink (docs/OBSERVABILITY.md §"Trace schema").
+
+Usage::
+
+    from consensus_tpu.obs import trace
+    trace.configure("run.trace.jsonl")
+    with trace.span("dispatch", r0=0, n_rounds=64) as sp:
+        ...                       # sp is a dict; mutate to add attrs
+        sp["bytes"] = 123         # recorded at span close
+    trace.event("attempt_failed", index=1)
+    trace.close()
+
+Design constraints:
+
+  * **Near-zero cost when disabled** (the default): ``span`` checks one
+    module global and yields ``None`` without allocating a record, so
+    instrumented hot paths (the runner's chunk loop) pay an ``is None``
+    test per call when tracing is off.
+  * **Monotonic timestamps**: ``t_s`` is ``time.perf_counter()``; the
+    first line of every file is a ``meta`` record anchoring that clock
+    to wall time (``unix_t0``), so post-processors can reconstruct
+    absolute times without the trace depending on a settable clock.
+  * **Crash-visible**: every record is one flushed line — a SIGKILL
+    mid-run loses at most the span currently open, never written lines
+    (the resilience layer's crash tests rely on artifacts surviving).
+  * **Profiler alignment**: ``configure(annotate_jax=True)`` wraps every
+    span body in ``jax.profiler.TraceAnnotation(name)`` so the host
+    lanes of a ``--profile`` trace carry the same boundaries as the
+    JSONL spans. jax is imported lazily, only on that path.
+
+Schema (version 1), one JSON object per line:
+
+  meta  : {"type": "meta", "version": 1, "clock": "perf_counter",
+           "t0_s": float, "unix_t0": float, "pid": int}
+  span  : {"type": "span", "name": str, "t_s": float, "dur_s": float,
+           "seq": int, "attrs": {str: scalar}}
+  event : {"type": "event", "name": str, "t_s": float, "seq": int,
+           "attrs": {str: scalar}}
+
+``seq`` is strictly increasing per file (spans are sequenced at *close*,
+so nested spans appear child-before-parent, like a profiler's end
+events). ``tools/validate_trace.py`` checks all of this and exits
+nonzero on drift.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+SCHEMA_VERSION = 1
+
+_LOCK = threading.Lock()
+_SINK = None          # open file object, or None
+_ANNOTATE = False     # mirror spans into jax.profiler.TraceAnnotation
+
+
+def _scalar(v):
+    """Coerce an attr value to a JSON scalar (numpy ints/floats included);
+    anything exotic becomes its repr — a trace line must never fail to
+    serialize."""
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    try:  # numpy scalars expose item()
+        return v.item()
+    except (AttributeError, ValueError):
+        return repr(v)
+
+
+def configure(path=None, *, annotate_jax: bool = False) -> None:
+    """Install the trace sink. ``path=None`` with ``annotate_jax=True``
+    enables profiler annotation without writing JSONL (the ``--profile``
+    -only CLI mode). Reconfiguring closes any previous sink."""
+    global _SINK, _ANNOTATE
+    close()
+    _ANNOTATE = bool(annotate_jax)
+    if path is None:
+        return
+    fp = open(path, "w")
+    fp.write(json.dumps({
+        "type": "meta", "version": SCHEMA_VERSION, "clock": "perf_counter",
+        "t0_s": time.perf_counter(), "unix_t0": time.time(),
+        "pid": os.getpid()}) + "\n")
+    fp.flush()
+    with _LOCK:
+        _SINK = fp
+        _SINK_seq[0] = 0
+
+
+_SINK_seq = [0]
+
+
+def close() -> None:
+    """Flush and detach the sink; disable profiler annotation."""
+    global _SINK, _ANNOTATE
+    with _LOCK:
+        sink, _SINK = _SINK, None
+        _ANNOTATE = False
+    if sink is not None:
+        sink.flush()
+        sink.close()
+
+
+def enabled() -> bool:
+    return _SINK is not None
+
+
+@contextlib.contextmanager
+def suspended():
+    """Temporarily suppress span/event emission (and profiler
+    annotation) — used around warmup passes whose dispatches would
+    otherwise be indistinguishable from the measured run's
+    (docs/OBSERVABILITY.md §"Warmup"). A span OPENED before suspension
+    still records at close, so a ``span("warmup")`` wrapping a
+    ``suspended()`` block yields exactly one line covering the pass."""
+    global _SINK, _ANNOTATE
+    with _LOCK:
+        sink, _SINK = _SINK, None
+        ann, _ANNOTATE = _ANNOTATE, False
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _SINK, _ANNOTATE = sink, ann
+
+
+def _emit(rec: dict) -> None:
+    with _LOCK:
+        sink = _SINK
+        if sink is None:
+            return
+        rec["seq"] = _SINK_seq[0]
+        _SINK_seq[0] += 1
+        sink.write(json.dumps(rec) + "\n")
+        sink.flush()
+
+
+def event(name: str, **attrs) -> None:
+    """Record a point-in-time event (no duration). No-op when disabled."""
+    if _SINK is None:
+        return
+    _emit({"type": "event", "name": name, "t_s": time.perf_counter(),
+           "attrs": {k: _scalar(v) for k, v in attrs.items()}})
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Time a block. Yields the attrs dict (mutate it to attach values
+    known only at the end, e.g. byte counts) — or ``None`` when tracing
+    is fully disabled, which is the fast path."""
+    if _SINK is None and not _ANNOTATE:
+        yield None
+        return
+    ctx = contextlib.nullcontext()
+    if _ANNOTATE:
+        import jax  # lazy: only --profile runs pay the import
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    t0 = time.perf_counter()
+    try:
+        with ctx:
+            yield attrs
+    finally:
+        if _SINK is not None:
+            _emit({"type": "span", "name": name, "t_s": t0,
+                   "dur_s": time.perf_counter() - t0,
+                   "attrs": {k: _scalar(v) for k, v in attrs.items()}})

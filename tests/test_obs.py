@@ -1,0 +1,396 @@
+"""Unified observability layer (docs/OBSERVABILITY.md).
+
+Three contracts under test:
+
+  1. **Digest neutrality** — on-device telemetry enabled vs disabled
+     yields bit-identical payloads for every engine family, and both
+     match the CPU oracle (telemetry reads the state update, never
+     feeds it).
+  2. **Counter soundness** — monotone protocol quantities accumulated
+     per round must equal the same quantity read off the final state
+     (entries_committed == Σ commit, blocks_appended == Σ chain_len,
+     ...), and must be invariant to scan chunking / sweep grouping.
+  3. **Artifact schemas** — trace JSONL and metrics snapshots written
+     by a real CLI run validate under tools/validate_trace.py (run as
+     a subprocess, exactly as CI would).
+"""
+import dataclasses
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.network import simulator
+from consensus_tpu.obs import metrics as obs_metrics
+from consensus_tpu.obs import trace as obs_trace
+
+from helpers import run_cached
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+ADV = dict(drop_rate=0.1, partition_rate=0.05, churn_rate=0.05)
+
+CFGS = {
+    "raft": Config(protocol="raft", n_nodes=5, n_rounds=48, n_sweeps=2,
+                   log_capacity=32, max_entries=16, **ADV),
+    "pbft": Config(protocol="pbft", f=1, n_nodes=4, n_rounds=24,
+                   log_capacity=8, **ADV),
+    "paxos": Config(protocol="paxos", n_nodes=7, n_rounds=24,
+                    log_capacity=8, **ADV),
+    "dpos": Config(protocol="dpos", n_nodes=24, n_rounds=32,
+                   log_capacity=48, n_candidates=8, n_producers=3,
+                   epoch_len=8, **ADV),
+}
+# The large-N variant engines (SPEC §3b / §6b) carry their own kernels —
+# telemetry must hold there too.
+VARIANTS = {
+    "raft-sparse": Config(protocol="raft", n_nodes=64, max_active=4,
+                          n_rounds=32, n_sweeps=2, log_capacity=16,
+                          max_entries=8, **ADV),
+    "pbft-bcast": Config(protocol="pbft", fault_model="bcast", f=5,
+                         n_nodes=16, n_rounds=24, log_capacity=8, **ADV),
+}
+
+
+def _run_telem(cfg, **kw):
+    return simulator.run(cfg, warmup=False, telemetry=True, **kw)
+
+
+# --- 1. digest neutrality ---------------------------------------------------
+
+@pytest.mark.parametrize("proto", list(CFGS))
+def test_telemetry_digest_neutral_vs_tpu_and_oracle(proto):
+    cfg = CFGS[proto]
+    on = _run_telem(cfg)
+    assert on.payload == run_cached(cfg).payload
+    # ... and the telemetry run still matches the C++ oracle byte-ish
+    # (the framework's acceptance criterion survives instrumentation).
+    assert on.payload == run_cached(
+        dataclasses.replace(cfg, engine="cpu")).payload
+    tel = on.extras["telemetry"]
+    assert set(tel["totals"]) == set(tel["per_sweep"]) == set(tel["names"])
+    for name, arr in tel["per_sweep"].items():
+        assert arr.shape == (cfg.n_sweeps,)
+        assert (arr >= 0).all(), name
+
+
+@pytest.mark.parametrize("name", list(VARIANTS))
+def test_telemetry_digest_neutral_variant_engines(name):
+    cfg = VARIANTS[name]
+    assert _run_telem(cfg).payload == run_cached(cfg).payload
+
+
+# --- 2. counter soundness ---------------------------------------------------
+
+def test_raft_entries_committed_matches_final_state():
+    r = _run_telem(CFGS["raft"])
+    # commit indices start at 0 and only advance; the accumulated
+    # per-round advance must equal the final commit indices, per sweep.
+    np.testing.assert_array_equal(
+        r.extras["telemetry"]["per_sweep"]["entries_committed"],
+        r.counts.sum(axis=1))
+    assert r.extras["telemetry"]["totals"]["leader_elections"] >= 1
+
+
+def test_pbft_commit_paths_partition_final_committed():
+    r = _run_telem(CFGS["pbft"])
+    per = r.extras["telemetry"]["per_sweep"]
+    # Every committed (node, slot) was reached exactly once, via its own
+    # 2f+1 tally or via decide gossip — the two counters partition the
+    # final committed count.
+    np.testing.assert_array_equal(
+        per["commit_quorums"] + per["commits_adopted"],
+        r.counts.sum(axis=1))
+
+
+def test_paxos_values_learned_matches_final_state():
+    r = _run_telem(CFGS["paxos"])
+    np.testing.assert_array_equal(
+        r.extras["telemetry"]["per_sweep"]["values_learned"],
+        r.counts.sum(axis=1))
+
+
+def test_dpos_blocks_appended_matches_final_state():
+    cfg = CFGS["dpos"]
+    r = _run_telem(cfg)
+    per = r.extras["telemetry"]["per_sweep"]
+    np.testing.assert_array_equal(per["blocks_appended"],
+                                  r.counts.sum(axis=1))
+    np.testing.assert_array_equal(
+        per["blocks_appended"] + per["missed_appends"],
+        np.full(cfg.n_sweeps, cfg.n_nodes * cfg.n_rounds))
+
+
+@pytest.mark.parametrize("repl", [dict(scan_chunk=7), dict(sweep_chunk=1)],
+                         ids=["scan_chunk", "sweep_chunk"])
+def test_telemetry_invariant_to_chunking(repl):
+    base = _run_telem(CFGS["raft"])
+    got = _run_telem(dataclasses.replace(CFGS["raft"], **repl))
+    assert got.payload == base.payload
+    for k, v in base.extras["telemetry"]["per_sweep"].items():
+        np.testing.assert_array_equal(
+            got.extras["telemetry"]["per_sweep"][k], v, err_msg=k)
+
+
+def test_runner_rejects_telemetry_without_stats():
+    from consensus_tpu.network import runner
+    with pytest.raises(ValueError, match="stats"):
+        runner.run(CFGS["raft"], simulator.engine_def(CFGS["raft"]),
+                   telemetry=True)
+
+
+# --- checkpoint IO accounting (recorded even with tracing off) --------------
+
+def test_checkpoint_io_recorded_in_extras(tmp_path):
+    ck = tmp_path / "ck.npz"
+    cfg = dataclasses.replace(CFGS["raft"], scan_chunk=16)
+    r = simulator.run(cfg, warmup=False, checkpoint_path=str(ck),
+                      resume=True)
+    io = r.extras["checkpoint_io"]
+    assert io["saves"] == 2  # saves at r=16, 32 (never after the last chunk)
+    assert io["bytes_written"] > 0 and io["save_s"] > 0
+    assert io["loads"] == 0
+    assert r.payload == run_cached(CFGS["raft"]).payload
+    # A resumed run counts the load side.
+    r2 = simulator.run(cfg, warmup=False, checkpoint_path=str(ck),
+                       resume=True)
+    io2 = r2.extras["checkpoint_io"]
+    assert io2["loads"] == 1 and io2["bytes_read"] > 0
+    assert r2.payload == r.payload
+
+
+# --- trace + metrics sinks --------------------------------------------------
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_trace", REPO / "tools" / "validate_trace.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_jsonl_schema(tmp_path):
+    path = tmp_path / "t.jsonl"
+    obs_trace.configure(str(path))
+    try:
+        with obs_trace.span("outer", k=1) as sp:
+            assert sp is not None
+            sp["bytes"] = np.int64(7)  # numpy scalars must serialize
+            with obs_trace.span("inner"):
+                pass
+        obs_trace.event("ev", why="test")
+    finally:
+        obs_trace.close()
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert [x["type"] for x in lines] == ["meta", "span", "span", "event"]
+    # Spans are sequenced at close: inner before outer.
+    assert [x.get("name") for x in lines[1:]] == ["inner", "outer", "ev"]
+    assert lines[2]["attrs"] == {"k": 1, "bytes": 7}
+    assert _load_validator().validate_trace(path) == []
+
+
+def test_trace_disabled_is_noop(tmp_path):
+    obs_trace.close()
+    with obs_trace.span("x") as sp:
+        assert sp is None  # fast path: no record allocated
+    obs_trace.event("y")   # must not raise
+
+
+def test_trace_suspended_and_metrics_paused(tmp_path):
+    path = tmp_path / "t.jsonl"
+    obs_trace.configure(str(path))
+    try:
+        with obs_trace.span("outer"):
+            with obs_trace.suspended():
+                with obs_trace.span("hidden"):
+                    pass
+                obs_trace.event("hidden_ev")
+    finally:
+        obs_trace.close()
+    names = [json.loads(x).get("name")
+             for x in path.read_text().splitlines()[1:]]
+    assert names == ["outer"]  # suspended block emitted nothing
+    reg = obs_metrics.Registry()
+    with obs_metrics.paused():
+        reg.counter("c").inc()
+        reg.histogram("h").observe(1.0)
+        reg.gauge("g").set(5)
+    reg.counter("c").inc()
+    snap = reg.snapshot()
+    assert snap["c"]["value"] == 1
+    assert snap["h"]["count"] == 0 and snap["g"]["value"] == 0
+
+
+def test_metrics_registry_and_prometheus():
+    reg = obs_metrics.Registry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(2)
+    reg.gauge("g").set(1.5)
+    h = reg.histogram("h", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["c"] == {"type": "counter", "value": 3}
+    assert snap["h"]["counts"] == [1, 1, 1]
+    assert snap["h"]["count"] == 3
+    with pytest.raises(TypeError):
+        reg.gauge("c")  # type shadowing is an error
+    with pytest.raises(ValueError):
+        reg.counter("c").inc(-1)
+    prom = reg.to_prometheus()
+    assert '# TYPE c counter' in prom and 'h_bucket{le="+Inf"} 3' in prom
+
+
+def test_metrics_snapshot_validates(tmp_path):
+    reg = obs_metrics.Registry()
+    reg.counter("a").inc(4)
+    reg.histogram("b").observe(0.2)
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps({"version": obs_metrics.SCHEMA_VERSION,
+                             "metrics": reg.snapshot()}))
+    assert _load_validator().validate_metrics(p) == []
+
+
+def test_validator_flags_drift(tmp_path):
+    v = _load_validator()
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"type": "span", "name": "x"}\n')  # no meta, no t_s
+    assert v.validate_trace(bad)
+    badm = tmp_path / "bad.json"
+    badm.write_text(json.dumps({"version": 1, "metrics":
+                                {"c": {"type": "counter", "value": -1}}}))
+    assert v.validate_metrics(badm)
+
+
+def test_supervisor_rejects_telemetry_on_cpu_engine():
+    from consensus_tpu.network import supervisor
+    with pytest.raises(ValueError, match="telemetry"):
+        supervisor.supervised_run(
+            dataclasses.replace(CFGS["raft"], engine="cpu"), telemetry=True)
+
+
+def test_run_report_to_json_roundtrip():
+    from consensus_tpu.network import supervisor
+    result = supervisor.supervised_run(CFGS["raft"], retries=0,
+                                       telemetry=True)
+    assert result.extras["telemetry"]["totals"]["entries_committed"] > 0
+    report = supervisor.RunReport(
+        retries=1, attempts=[supervisor.Attempt(0, 0, 0.25, error="boom"),
+                             supervisor.Attempt(1, 16, 0.5)],
+        resumed_from_round=16)
+    d = json.loads(report.to_json())
+    assert d["n_attempts"] == 2
+    assert d["attempts"][0]["wall_s"] == 0.25
+    assert d["attempts"][1]["start_round"] == 16
+
+
+# --- CI seam: a fresh CLI run's artifacts pass the validator ----------------
+
+def test_cli_artifacts_validate_and_digest_stable(tmp_path, capsys):
+    from consensus_tpu import cli
+    flags = ["--protocol", "raft", "--nodes", "5", "--rounds", "32",
+             "--sweeps", "2", "--log-capacity", "16", "--max-entries", "8",
+             "--drop-rate", "0.1", "--engine", "tpu", "--scan-chunk", "8"]
+    trace = tmp_path / "run.trace.jsonl"
+    metrics = tmp_path / "metrics.json"
+    rc = cli.main(flags + ["--telemetry", "--trace-out", str(trace),
+                           "--metrics-out", str(metrics)])
+    assert rc == 0
+    with_tel = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    rc = cli.main(flags)
+    assert rc == 0
+    plain = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert with_tel["digest"] == plain["digest"]
+    assert with_tel["telemetry"]["entries_committed"] >= 0
+
+    # The CI tripwire, exactly as CI runs it: subprocess, nonzero on
+    # drift. validate_trace.py imports neither jax nor the framework,
+    # so the subprocess is cheap.
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "validate_trace.py"),
+         "--trace", str(trace), "--metrics", str(metrics)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(metrics.read_text())
+    assert doc["metrics"]["dispatch_wall_s"]["count"] >= 4  # 32/8 chunks
+
+
+def test_cli_artifacts_exclude_warmup(tmp_path, capsys):
+    """The hidden warmup pass (compile) must not pollute exported
+    artifacts: dispatch_wall_s counts exactly the timed run's chunks,
+    and the trace shows one 'warmup' span, not its inner dispatches."""
+    from consensus_tpu import cli
+    obs_metrics.reset()  # the default registry is process-cumulative
+    trace = tmp_path / "t.jsonl"
+    metrics = tmp_path / "m.json"
+    rc = cli.main(["--protocol", "raft", "--nodes", "5", "--rounds", "32",
+                   "--log-capacity", "16", "--max-entries", "8",
+                   "--engine", "tpu", "--scan-chunk", "8",
+                   "--trace-out", str(trace), "--metrics-out", str(metrics)])
+    assert rc == 0
+    capsys.readouterr()
+    doc = json.loads(metrics.read_text())
+    assert doc["metrics"]["dispatch_wall_s"]["count"] == 4  # 32/8, once
+    names = [json.loads(x).get("name")
+             for x in trace.read_text().splitlines()[1:]]
+    assert names.count("warmup") == 1
+    assert names.count("dispatch") == 4
+
+
+def test_cli_failed_supervised_run_still_writes_artifacts(tmp_path, capsys):
+    """When every attempt fails, --metrics-out and the RunReport dump
+    must still land — they are the failure-diagnosis artifacts."""
+    from consensus_tpu import cli
+    from consensus_tpu.network import faults, supervisor
+    metrics = tmp_path / "m.json"
+    faults.install(transient_dispatches=(1, 2))
+    try:
+        with pytest.raises(supervisor.SupervisorError):
+            cli.main(["--protocol", "raft", "--nodes", "5", "--rounds", "8",
+                      "--log-capacity", "8", "--max-entries", "4",
+                      "--engine", "tpu", "--retries", "1",
+                      "--metrics-out", str(metrics)])
+    finally:
+        faults.reset()
+    capsys.readouterr()
+    report = tmp_path / "m.run_report.json"
+    assert metrics.exists() and report.exists()
+    assert _load_validator().validate_metrics(metrics) == []
+    assert _load_validator().validate_report(report) == []
+    doc = json.loads(report.read_text())
+    assert doc["n_attempts"] == 2
+    assert all(a["error"] for a in doc["attempts"])
+
+
+def test_cli_failed_unsupervised_run_still_writes_metrics(tmp_path, capsys):
+    """Even without a supervisor, a run that dies mid-flight leaves its
+    partial metrics snapshot (main's finally, not the success tail)."""
+    from consensus_tpu import cli
+    from consensus_tpu.network import faults
+    metrics = tmp_path / "m.json"
+    faults.install(transient_dispatches=(1,))
+    try:
+        with pytest.raises(faults.InjectedTransientError):
+            cli.main(["--protocol", "raft", "--nodes", "5", "--rounds", "8",
+                      "--log-capacity", "8", "--max-entries", "4",
+                      "--engine", "tpu", "--metrics-out", str(metrics)])
+    finally:
+        faults.reset()
+    capsys.readouterr()
+    assert metrics.exists()
+    assert _load_validator().validate_metrics(metrics) == []
+
+
+def test_cli_prometheus_metrics_out(tmp_path, capsys):
+    from consensus_tpu import cli
+    prom = tmp_path / "metrics.prom"
+    rc = cli.main(["--protocol", "paxos", "--nodes", "5", "--rounds", "8",
+                   "--log-capacity", "4", "--engine", "tpu",
+                   "--metrics-out", str(prom)])
+    assert rc == 0
+    capsys.readouterr()
+    assert "# TYPE dispatch_wall_s histogram" in prom.read_text()

@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""Schema validator for observability artifacts (docs/OBSERVABILITY.md).
+
+    python tools/validate_trace.py --trace run.trace.jsonl \
+                                   --metrics metrics.json \
+                                   [--report run.run_report.json]
+
+Exits nonzero (with one line per violation on stderr) when any file
+drifts from the documented schema — the CI tripwire that keeps the
+trace/metrics formats stable for downstream consumers (the benchmark
+embedding, the driver's BENCH parts).
+
+Deliberately stdlib-only and import-free of the framework: the tier-1
+test runs it as a subprocess and must not pay a jax import.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+TRACE_VERSION = 1
+METRICS_VERSION = 1
+
+_SCALAR = (bool, int, float, str, type(None))
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and math.isfinite(v)
+
+
+def validate_trace(path) -> list:
+    """Return a list of violation strings (empty = valid JSONL trace)."""
+    errs = []
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as exc:
+        return [f"{path}: unreadable: {exc}"]
+    if not lines:
+        return [f"{path}: empty trace (expected at least a meta line)"]
+    recs = []
+    for i, line in enumerate(lines, 1):
+        try:
+            rec = json.loads(line)
+        except ValueError as exc:
+            errs.append(f"{path}:{i}: not JSON: {exc}")
+            continue
+        if not isinstance(rec, dict):
+            errs.append(f"{path}:{i}: record is not an object")
+            continue
+        recs.append((i, rec))
+    if not recs:
+        return errs
+    i0, meta = recs[0]
+    if meta.get("type") != "meta":
+        errs.append(f"{path}:{i0}: first record must be meta, "
+                    f"got {meta.get('type')!r}")
+    else:
+        if meta.get("version") != TRACE_VERSION:
+            errs.append(f"{path}:{i0}: meta.version {meta.get('version')!r} "
+                        f"!= {TRACE_VERSION}")
+        for key in ("clock", "t0_s", "unix_t0", "pid"):
+            if key not in meta:
+                errs.append(f"{path}:{i0}: meta missing {key!r}")
+    last_seq = -1
+    for i, rec in recs[1:]:
+        typ = rec.get("type")
+        if typ not in ("span", "event"):
+            errs.append(f"{path}:{i}: unknown type {typ!r}")
+            continue
+        if not isinstance(rec.get("name"), str) or not rec["name"]:
+            errs.append(f"{path}:{i}: missing/empty name")
+        if not _num(rec.get("t_s")) or rec["t_s"] < 0:
+            errs.append(f"{path}:{i}: t_s must be a finite number >= 0")
+        if typ == "span" and (not _num(rec.get("dur_s"))
+                              or rec["dur_s"] < 0):
+            errs.append(f"{path}:{i}: span dur_s must be a finite "
+                        "number >= 0")
+        seq = rec.get("seq")
+        if not isinstance(seq, int) or isinstance(seq, bool):
+            errs.append(f"{path}:{i}: seq must be an int")
+        elif seq <= last_seq:
+            errs.append(f"{path}:{i}: seq {seq} not strictly increasing "
+                        f"(prev {last_seq})")
+        else:
+            last_seq = seq
+        attrs = rec.get("attrs")
+        if not isinstance(attrs, dict):
+            errs.append(f"{path}:{i}: attrs must be an object")
+        else:
+            for k, v in attrs.items():
+                if not isinstance(v, _SCALAR):
+                    errs.append(f"{path}:{i}: attr {k!r} is not a "
+                                f"JSON scalar ({type(v).__name__})")
+    return errs
+
+
+def _validate_histogram(name: str, d: dict) -> list:
+    errs = []
+    bounds, counts = d.get("bounds"), d.get("counts")
+    if not isinstance(bounds, list) or not isinstance(counts, list):
+        return [f"histogram {name}: bounds/counts must be lists"]
+    if sorted(set(bounds)) != bounds or not all(_num(b) for b in bounds):
+        errs.append(f"histogram {name}: bounds not strictly increasing "
+                    "numbers")
+    if len(counts) != len(bounds) + 1:
+        errs.append(f"histogram {name}: len(counts) {len(counts)} != "
+                    f"len(bounds)+1 {len(bounds) + 1}")
+    if not all(isinstance(c, int) and c >= 0 for c in counts):
+        errs.append(f"histogram {name}: counts must be ints >= 0")
+    elif d.get("count") != sum(counts):
+        errs.append(f"histogram {name}: count {d.get('count')} != "
+                    f"sum(counts) {sum(counts)}")
+    if not _num(d.get("sum")):
+        errs.append(f"histogram {name}: sum must be a finite number")
+    return errs
+
+
+def validate_metrics(path) -> list:
+    """Return a list of violation strings (empty = valid snapshot)."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable/not JSON: {exc}"]
+    if not isinstance(doc, dict):
+        return [f"{path}: top level must be an object"]
+    errs = []
+    if doc.get("version") != METRICS_VERSION:
+        errs.append(f"{path}: version {doc.get('version')!r} != "
+                    f"{METRICS_VERSION}")
+    metrics = doc.get("metrics")
+    if not isinstance(metrics, dict):
+        return errs + [f"{path}: 'metrics' must be an object"]
+    for name, d in metrics.items():
+        if not isinstance(d, dict):
+            errs.append(f"{path}: metric {name!r} must be an object")
+            continue
+        typ = d.get("type")
+        if typ == "counter":
+            if not _num(d.get("value")) or d["value"] < 0:
+                errs.append(f"{path}: counter {name} value must be >= 0")
+        elif typ == "gauge":
+            if not _num(d.get("value")):
+                errs.append(f"{path}: gauge {name} value must be a number")
+        elif typ == "histogram":
+            errs += [f"{path}: {e}" for e in _validate_histogram(name, d)]
+        else:
+            errs.append(f"{path}: metric {name!r} has unknown type {typ!r}")
+    return errs
+
+
+def validate_report(path) -> list:
+    """Light checks for a supervised RunReport dump."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable/not JSON: {exc}"]
+    errs = []
+    attempts = doc.get("attempts")
+    if not isinstance(attempts, list):
+        errs.append(f"{path}: 'attempts' must be a list")
+        attempts = []
+    if doc.get("n_attempts") != len(attempts):
+        errs.append(f"{path}: n_attempts {doc.get('n_attempts')!r} != "
+                    f"len(attempts) {len(attempts)}")
+    for k, a in enumerate(attempts):
+        if not _num(a.get("wall_s")) or a["wall_s"] < 0:
+            errs.append(f"{path}: attempts[{k}].wall_s must be >= 0")
+        if not isinstance(a.get("start_round"), int):
+            errs.append(f"{path}: attempts[{k}].start_round must be an int")
+    for key in ("resumed_from_round", "fallback_used", "deadline_exceeded"):
+        if key not in doc:
+            errs.append(f"{path}: missing key {key!r}")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Validate trace JSONL / metrics JSON / RunReport "
+                    "files against the docs/OBSERVABILITY.md schema.")
+    ap.add_argument("--trace", default="", help="span/event JSONL file")
+    ap.add_argument("--metrics", default="", help="metrics snapshot JSON")
+    ap.add_argument("--report", default="", help="RunReport JSON")
+    args = ap.parse_args(argv)
+    if not (args.trace or args.metrics or args.report):
+        ap.error("nothing to validate: pass --trace/--metrics/--report")
+    errs = []
+    if args.trace:
+        errs += validate_trace(args.trace)
+    if args.metrics:
+        errs += validate_metrics(args.metrics)
+    if args.report:
+        errs += validate_report(args.report)
+    for e in errs:
+        print(f"validate_trace: {e}", file=sys.stderr)
+    if errs:
+        print(f"validate_trace: FAILED ({len(errs)} violations)",
+              file=sys.stderr)
+        return 1
+    print("validate_trace: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
