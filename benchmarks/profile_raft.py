@@ -176,7 +176,7 @@ def _timers_only_round(cfg, st, r):
                                            uidx), timeout)
     return raft.RaftState(seed, term, role, voted_for, st.log_term,
                           st.log_val, st.log_len, st.commit, timer, timeout,
-                          st.match_idx, st.next_idx)
+                          st.match_idx, st.next_idx, st.down)
 
 
 if __name__ == "__main__":

@@ -31,6 +31,9 @@ _FLAG_FIELDS = {
     "drop_rate": ("drop_rate", 0.0),
     "partition_rate": ("partition_rate", 0.0),
     "churn_rate": ("churn_rate", 0.0),
+    "crash_prob": ("crash_prob", 0.0),
+    "recover_prob": ("recover_prob", 0.0),
+    "max_crashed": ("max_crashed", 0),
     "f": ("f", 1),
     "view_timeout": ("view_timeout", 8),
     "n_byzantine": ("n_byzantine", 0),
@@ -45,7 +48,8 @@ _FLAG_FIELDS = {
 }
 _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
                "fault_model": str, "drop_rate": float,
-               "partition_rate": float, "churn_rate": float}
+               "partition_rate": float, "churn_rate": float,
+               "crash_prob": float, "recover_prob": float}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -67,6 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--checkpoint", default="",
                     help="checkpoint file; resumes from the newest valid "
                          "(checksum-verified) rotation if present")
+    ap.add_argument("--fsync-checkpoints", action="store_true",
+                    help="fsync each snapshot's bytes before (and its "
+                         "directory entry after) the atomic rename, making "
+                         "checkpoints durable against power loss, not just "
+                         "process death (docs/RESILIENCE.md §2b); requires "
+                         "--checkpoint")
     ap.add_argument("--keep-checkpoints", type=int,
                     default=argparse.SUPPRESS,
                     help="retain the last K checkpoint rotations "
@@ -223,6 +233,7 @@ def main(argv=None) -> int:
             ("--mesh" if "mesh" in typed else "config field mesh_shape",
              "mesh" in typed or cfg.mesh_shape),
             ("--checkpoint", args.checkpoint),
+            ("--fsync-checkpoints", args.fsync_checkpoints),
             ("--keep-checkpoints", "keep_checkpoints" in typed),
             ("--retries", args.retries),
             ("--deadline", args.deadline),
@@ -250,6 +261,9 @@ def main(argv=None) -> int:
     if "keep_checkpoints" in vars(args) and not args.checkpoint:
         parser.error("--keep-checkpoints requires --checkpoint (it is the "
                      "snapshot rotation depth)")
+    if args.fsync_checkpoints and not args.checkpoint:
+        parser.error("--fsync-checkpoints requires --checkpoint (there is "
+                     "nothing to make durable without snapshots)")
     if keep < 1:
         parser.error(f"--keep-checkpoints must be >= 1, got {keep}")
     if args.retries < 0:
@@ -270,6 +284,7 @@ def main(argv=None) -> int:
             ("--retries/--deadline/--fallback-cpu", supervise),
             ("--sweeps", cfg.n_sweeps != 1),
             ("--fault-model bcast", cfg.fault_model == "bcast"),
+            ("--crash-prob", cfg.crash_prob > 0),
             ("--telemetry", args.telemetry),
         ] if on]
         if unsupported:
@@ -356,7 +371,8 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
     run_kw = {}
     if args.checkpoint:
         run_kw = dict(checkpoint_path=args.checkpoint, resume=True,
-                      keep_checkpoints=keep)
+                      keep_checkpoints=keep,
+                      fsync_checkpoints=args.fsync_checkpoints)
     if args.telemetry:
         run_kw["telemetry"] = True
 
@@ -369,6 +385,7 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
                 fallback_cpu=args.fallback_cpu,
                 checkpoint_path=args.checkpoint or None,
                 keep_checkpoints=keep,
+                fsync_checkpoints=args.fsync_checkpoints,
                 telemetry=args.telemetry)
         except supervisor.SupervisorError as exc:
             # Park the give-up report for main's finally to dump.

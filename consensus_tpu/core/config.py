@@ -48,6 +48,16 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     partition_rate: float = 0.0  # per round: bipartition active?
     churn_rate: float = 0.0      # per round: all leaders forced to step down
 
+    # Crash-recover adversary (SPEC §6c; tpu engine only — the C++ oracle
+    # does not implement it, so crash_prob > 0 is rejected on engine="cpu"
+    # rather than silently diverging). Per round: each up node crashes
+    # with crash_prob (losing volatile state, capped at max_crashed
+    # simultaneously-down nodes; 0 = no cap) and each down node recovers
+    # with recover_prob, rejoining from its persisted state.
+    crash_prob: float = 0.0
+    recover_prob: float = 0.0
+    max_crashed: int = 0
+
     # PBFT.
     f: int = 1                   # byzantine tolerance; n_nodes = 3f+1
     view_timeout: int = 8        # rounds without progress before view change
@@ -108,6 +118,14 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             raise ValueError(
                 "fault_model='bcast' (SPEC §6b) is a pbft model; other "
                 "protocols would silently ignore it")
+        if self.max_crashed < 0 or self.max_crashed > self.n_nodes:
+            raise ValueError("max_crashed must be in [0, n_nodes] "
+                             "(0 = no cap on simultaneous crashes)")
+        if self.crash_prob > 0 and self.engine == "cpu":
+            raise ValueError(
+                "crash_prob > 0 is a tpu-engine adversary (SPEC §6c); the "
+                "C++ oracle does not implement it and would silently "
+                "simulate different trajectories")
         if self.t_max <= self.t_min:
             raise ValueError("t_max must exceed t_min")
         if self.max_active < 0:
@@ -145,6 +163,14 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     def churn_cutoff(self) -> int:
         return prob_threshold_u32(self.churn_rate)
 
+    @property
+    def crash_cutoff(self) -> int:
+        return prob_threshold_u32(self.crash_prob)
+
+    @property
+    def recover_cutoff(self) -> int:
+        return prob_threshold_u32(self.recover_prob)
+
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
         d["mesh_shape"] = list(self.mesh_shape)
@@ -152,6 +178,8 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             "drop": self.drop_cutoff,
             "partition": self.partition_cutoff,
             "churn": self.churn_cutoff,
+            "crash": self.crash_cutoff,
+            "recover": self.recover_cutoff,
         }
         return json.dumps(d, indent=2)
 

@@ -51,6 +51,9 @@ STREAM_VOTE = np.uint32(0xD3A2646C)     # per (epoch, validator) vote target
 STREAM_VALUE = np.uint32(0xFD7046C5)    # proposal payload values
 STREAM_BYZANTINE = np.uint32(0xB55A4F09)  # per-config byzantine node pick
 STREAM_EQUIV = np.uint32(0x94D049BB)    # per (round, byz sender, receiver) stance
+# SPEC §6c crash-recover adversary. TPU-engine only (not mirrored in
+# cpp/oracle.cpp; Config rejects crash_prob > 0 on the cpu engine).
+STREAM_CRASH = np.uint32(0x68E31DA5)    # per (round, node) crash/recover draw
 
 
 def _rotl32_np(x: np.ndarray, r: int) -> np.ndarray:

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..core import rng
 from ..core.config import Config
+from ..ops.adversary import CRASH_TELEMETRY, crash_counts, crash_transition
 from .raft import _draw, _lt, _store_dtype
 
 
@@ -25,6 +26,7 @@ class DposState(NamedTuple):
     chain_r: jnp.ndarray    # [V, L] _store_dtype(n_rounds-1) — block round
     chain_p: jnp.ndarray    # [V, L] _store_dtype(n_candidates-1) — producer
     chain_len: jnp.ndarray  # [V] i32
+    down: jnp.ndarray       # [V] bool — SPEC §6c crashed mask
 
 
 def dpos_schedule(cfg: Config, seed):
@@ -70,7 +72,8 @@ def _producer_delivery(cfg: Config, seed, r, p):
 DPOS_TELEMETRY = ("blocks_appended",     # validator-chain extensions
                   "missed_appends",      # validators not extended
                   "producer_rotations",  # slot handoffs p_{r-1} != p_r
-                  "churn_slots")         # rounds churned (no block)
+                  "churn_slots",         # rounds churned (no block)
+                  ) + CRASH_TELEMETRY    # SPEC §6c (zeros when disabled)
 
 
 def dpos_round(cfg: Config, producers, st: DposState, r, *,
@@ -83,9 +86,23 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
     churn = _draw(seed, rng.STREAM_CHURN, jnp.asarray(r, jnp.uint32), 0, 0) \
         < _lt(cfg.churn_cutoff)
 
+    # SPEC §6c crash-recover adversary: a down producer is offline (no
+    # block this round, like churn) and down validators miss the
+    # broadcast — their chains simply stop growing while crashed. The
+    # chain is durable; dpos carries no volatile per-node state, so
+    # recovery is plain reachability again.
+    crash_on = cfg.crash_cutoff > 0
+    down = st.down
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, jnp.asarray(r, jnp.uint32), down, cfg.crash_cutoff,
+            cfg.recover_cutoff, cfg.max_crashed)
+
     recv = _producer_delivery(cfg, seed, r, p)
     recv = recv | (jnp.arange(V, dtype=jnp.int32) == p)   # self-append
     append = recv & ~churn & (st.chain_len < L)
+    if crash_on:
+        append = append & ~down & ~down[p]
 
     slot_hot = (jnp.arange(L, dtype=jnp.int32)[None, :] == st.chain_len[:, None]) \
         & append[:, None]
@@ -93,16 +110,17 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
                         st.chain_r)
     chain_p = jnp.where(slot_hot, p.astype(st.chain_p.dtype), st.chain_p)
     chain_len = st.chain_len + append.astype(jnp.int32)
-    new = DposState(seed, chain_r, chain_p, chain_len)
+    new = DposState(seed, chain_r, chain_p, chain_len, down)
     if not telem:
         return new
     rp = jnp.maximum(r - 1, 0)  # previous slot's producer (r=0: no handoff)
     p_prev = producers[rp // cfg.epoch_len,
                        (rp % cfg.epoch_len) % cfg.n_producers]
     n_app = jnp.sum(append.astype(jnp.int32))
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
     vec = jnp.stack([n_app, jnp.int32(V) - n_app,
                      ((r > 0) & (p != p_prev)).astype(jnp.int32),
-                     churn.astype(jnp.int32)])
+                     churn.astype(jnp.int32), *cz])
     return new, vec
 
 
@@ -118,7 +136,7 @@ def dpos_make_carry(cfg: Config, seed):
     st0 = DposState(jnp.asarray(seed, jnp.uint32),
                     jnp.zeros((V, L), _store_dtype(cfg.n_rounds - 1)),
                     jnp.zeros((V, L), _store_dtype(cfg.n_candidates - 1)),
-                    jnp.zeros(V, jnp.int32))
+                    jnp.zeros(V, jnp.int32), jnp.zeros(V, bool))
     return producers, st0
 
 
@@ -146,7 +164,7 @@ def _dpos_pspec(cfg: Config):
     # The [E, K] schedule is replicated; chain state shards over validators.
     return (P(None, None),
             DposState(seed=P(), chain_r=P(ND, None), chain_p=P(ND, None),
-                      chain_len=P(ND)))
+                      chain_len=P(ND), down=P(ND)))
 
 
 _ENGINE = None

@@ -24,6 +24,8 @@ import numpy as np
 
 from ..core import rng
 from ..core.config import Config
+from ..ops.adversary import (CRASH_TELEMETRY, crash_counts,
+                             crash_transition, freeze_down)
 from .raft import _delivery, _draw, _i32, _lt  # shared SPEC §2 adversary
 
 
@@ -34,13 +36,14 @@ class PaxosState(NamedTuple):
     acc_val: jnp.ndarray       # [N, S] i32
     learned_val: jnp.ndarray   # [N, S] i32
     learned_mask: jnp.ndarray  # [N, S] bool
+    down: jnp.ndarray          # [N] bool — SPEC §6c crashed mask
 
 
 def paxos_init(cfg: Config, seed) -> PaxosState:
     N, S = cfg.n_nodes, cfg.log_capacity
     z = jnp.zeros((N, S), jnp.int32)
     return PaxosState(jnp.asarray(seed, jnp.uint32), z, z, z, z,
-                      jnp.zeros((N, S), bool))
+                      jnp.zeros((N, S), bool), jnp.zeros(N, bool))
 
 
 # On-device protocol telemetry (docs/OBSERVABILITY.md). "nacks" counts
@@ -51,7 +54,8 @@ PAXOS_TELEMETRY = ("promises",           # promise responses delivered
                    "nacks",              # delivered prepares outbid
                    "accepts",            # accepted responses delivered
                    "proposals_decided",  # proposers reaching majority
-                   "values_learned")     # (node, slot) newly learned
+                   "values_learned",     # (node, slot) newly learned
+                   ) + CRASH_TELEMETRY   # SPEC §6c (zeros when disabled)
 
 
 def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False):
@@ -65,6 +69,24 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False):
 
     deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+
+    # SPEC §6c crash-recover adversary. Volatile on recovery: promised[]
+    # (safe here because ballots r·N+p+1 strictly increase across rounds,
+    # so no later prepare can be outbid by a forgotten promise — see SPEC
+    # §6c); durable: acc_bal/acc_val (the accepted-value history Paxos
+    # safety rests on) and the learner state.
+    crash_on = cfg.crash_cutoff > 0
+    down = st.down
+    promised0 = st.promised
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,
+            cfg.max_crashed)
+        up = ~down
+        deliver = deliver & up[:, None] & up[None, :]
+        promised0 = jnp.where(rec[:, None], 0, promised0)
+        frozen = (promised0, st.acc_bal, st.acc_val, st.learned_val,
+                  st.learned_mask)
 
     is_prop = (idx < P) & ~churn
     slot_p = (_draw(seed, rng.STREAM_VALUE, ur, 1, idx.astype(jnp.uint32))
@@ -85,14 +107,14 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False):
     # Phase 1: prepares → per-slot max delivered ballot at each acceptor.
     data1 = jnp.where(is_prop[None, :] & prep_del, ballot[None, :], 0)  # [A, P]
     p_max = seg_max(data1)                                              # [A, S]
-    new_promised = jnp.maximum(st.promised, p_max)
+    new_promised = jnp.maximum(promised0, p_max)
 
     # Phase 2: promises (only the highest delivered ballot per slot wins).
     # Gather columns by slot_p directly — st.promised[:, slot_p] lowers to
     # one XLA gather; the earlier take_along_axis(slot_p.repeat(N, 0))
     # form materialized three [N, P] i32 index matrices (~400 MB each at
     # the BASELINE.json:10 10k x 10k shape) before gathering.
-    po = st.promised[:, slot_p]                                         # [A, P]
+    po = promised0[:, slot_p]                                           # [A, P]
     npo = new_promised[:, slot_p]
     prom = (is_prop[None, :] & prep_del & resp_del
             & (ballot[None, :] > po) & (ballot[None, :] == npo))        # [A, P]
@@ -147,14 +169,23 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False):
     learned_val = jnp.where(learn_now, lv_in, st.learned_val)
     learned_mask = st.learned_mask | found
 
+    if crash_on:
+        # SPEC §6c freeze: a down node's acceptor + learner state holds
+        # its post-reset value (delivery masking already kept its
+        # flights out of every tally).
+        (promised2, acc_bal2, acc_val2, learned_val, learned_mask) = \
+            freeze_down(down, frozen, (promised2, acc_bal2, acc_val2,
+                                       learned_val, learned_mask))
+
     new = PaxosState(seed, promised2, acc_bal2, acc_val2, learned_val,
-                     learned_mask)
+                     learned_mask, down)
     if not telem:
         return new
     cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
     nack = is_prop[None, :] & prep_del & resp_del & ~prom
     vec = jnp.stack([cnt(prom), cnt(nack), cnt(accd), cnt(decided),
-                     cnt(learn_now)])
+                     cnt(learn_now), *cz])
     return new, vec
 
 
@@ -173,7 +204,7 @@ def _paxos_pspec(cfg: Config) -> PaxosState:
     from ..parallel.mesh import NODE_AXIS as ND
     m = P(ND, None)
     return PaxosState(seed=P(), promised=m, acc_bal=m, acc_val=m,
-                      learned_val=m, learned_mask=m)
+                      learned_val=m, learned_mask=m, down=P(ND))
 
 
 _ENGINE = None

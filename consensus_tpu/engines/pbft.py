@@ -20,6 +20,8 @@ import numpy as np
 
 from ..core import rng
 from ..core.config import Config
+from ..ops.adversary import (CRASH_TELEMETRY, crash_counts,
+                             crash_transition, freeze_down)
 from .raft import _delivery, _draw, _i32, _lt
 
 
@@ -33,6 +35,7 @@ class PbftState(NamedTuple):
     prepared: jnp.ndarray   # [N, S] bool
     committed: jnp.ndarray  # [N, S] bool
     dval: jnp.ndarray       # [N, S] i32
+    down: jnp.ndarray       # [N] bool — SPEC §6c crashed mask
 
 
 def _vth_select(w, f, vmax):
@@ -79,7 +82,8 @@ def pbft_init(cfg: Config, seed) -> PbftState:
     z = jnp.zeros(N, jnp.int32)
     zs = jnp.zeros((N, S), jnp.int32)
     bs = jnp.zeros((N, S), bool)
-    return PbftState(jnp.asarray(seed, jnp.uint32), z, z, bs, zs, zs, bs, bs, zs)
+    return PbftState(jnp.asarray(seed, jnp.uint32), z, z, bs, zs, zs, bs, bs,
+                     zs, jnp.zeros(N, bool))
 
 
 # On-device protocol telemetry (docs/OBSERVABILITY.md): the per-phase
@@ -91,7 +95,8 @@ PBFT_TELEMETRY = ("prepare_quorums",   # (node, slot) newly prepared
                   "commit_quorums",    # committed via own 2f+1 tally
                   "commit_missed",     # prepared, uncommitted, tally < Q
                   "commits_adopted",   # committed via decide gossip
-                  "view_changes")      # Σ per-node view advance
+                  "view_changes",      # Σ per-node view advance
+                  ) + CRASH_TELEMETRY  # SPEC §6c (zeros when disabled)
 
 
 def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
@@ -104,6 +109,16 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     sarange = jnp.arange(S, dtype=jnp.int32)
 
     deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    # SPEC §6c crash-recover adversary: down nodes neither send nor
+    # receive; static no-op when crash_cutoff == 0 (digest-neutral).
+    crash_on = cfg.crash_cutoff > 0
+    down = st.down
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,
+            cfg.max_crashed)
+        up = ~down
+        deliver = deliver & up[:, None] & up[None, :]
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
     honest = idx < (N - cfg.n_byzantine)
     d_h = deliver & honest[:, None]               # honest-sender delivery
@@ -123,6 +138,15 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     view, timer = st.view, st.timer
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
     prepared, committed, dval = st.prepared, st.committed, st.dval
+    if crash_on:
+        # Volatile reset on recovery (SPEC §6c): view/timer rejoin at 0
+        # (P1's f+1 catch-up restores the view from live peers); the
+        # per-slot message log — pp_*, prepared, committed, dval — is
+        # the persisted state PBFT's safety argument rests on.
+        view = jnp.where(rec, 0, view)
+        timer = jnp.where(rec, 0, timer)
+        frozen = (view, timer, pp_seen, pp_view, pp_val, prepared,
+                  committed, dval)
     committed_at_start = committed
 
     # ---- P0 churn: synchronized view bump.
@@ -217,14 +241,26 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
                       timer + 1)
 
+    if crash_on:
+        # SPEC §6c freeze: down nodes hold their post-reset state.
+        (view, timer, pp_seen, pp_view, pp_val, prepared, committed,
+         dval) = freeze_down(
+            down, frozen, (view, timer, pp_seen, pp_view, pp_val,
+                           prepared, committed, dval))
+
     new = PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
-                    prepared, committed, dval)
+                    prepared, committed, dval, down)
     if not telem:
         return new
     cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    # view_changes clips per-node deltas at 0: a §6c recovery resets the
+    # node's view to 0, and a raw sum would let that cancel real
+    # advances (identical to the plain delta when crashes are off —
+    # views never decrease otherwise).
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
-                     jnp.sum(view - st.view)])
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
     return new, vec
 
 
@@ -242,7 +278,7 @@ def _pbft_pspec(cfg: Config) -> PbftState:
     from ..parallel.mesh import NODE_AXIS as ND
     v, m = P(ND), P(ND, None)
     return PbftState(seed=P(), view=v, timer=v, pp_seen=m, pp_view=m,
-                     pp_val=m, prepared=m, committed=m, dval=m)
+                     pp_val=m, prepared=m, committed=m, dval=m, down=v)
 
 
 _ENGINE = None

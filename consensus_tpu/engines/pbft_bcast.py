@@ -41,6 +41,7 @@ import numpy as np
 
 from ..core import rng
 from ..core.config import Config
+from ..ops.adversary import crash_counts, crash_transition, freeze_down
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import bitcast_i32 as _i32
@@ -138,6 +139,20 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     # else. The general path is untouched.
     no_part = cfg.partition_cutoff == 0
     bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
+    # SPEC §6c crash-recover adversary: a down node's round broadcasts
+    # drop atomically (folded into the per-sender bcast flag — exactly
+    # the §6b fault granularity); the receiving side is handled by
+    # masking the quorum/adopt events with `up` (the down flag rides
+    # the P4/P5 sort payload), so a frozen node also never *counts* a
+    # quorum it cannot apply — and then the state freeze below.
+    crash_on = cfg.crash_cutoff > 0
+    down = st.down
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,
+            cfg.max_crashed)
+        up = ~down
+        bcast = bcast & up
     if not no_part:
         part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
                        < _lt(cfg.partition_cutoff))
@@ -158,6 +173,14 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     view, timer = st.view, st.timer
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
     prepared, committed, dval = st.prepared, st.committed, st.dval
+    if crash_on:
+        # Volatile reset on recovery (SPEC §6c): view/timer rejoin at 0;
+        # the per-slot message log persists (same split as the dense §6
+        # kernel — the fault granularity changes, the state split not).
+        view = jnp.where(rec, 0, view)
+        timer = jnp.where(rec, 0, timer)
+        frozen = (view, timer, pp_seen, pp_view, pp_val, prepared,
+                  committed, dval)
     committed_at_start = committed
 
     # ---- P0 churn.
@@ -266,6 +289,8 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     if not no_part:
         bits |= ((b32(side) | (b32(side_ok(0)) << 1)
                   | (b32(side_ok(1)) << 2))[:, None] << 5)
+    if crash_on:
+        bits |= b32(up)[:, None] << 8
     tal = _SortedTally(pp_val.T, bits.T, extra_sn)
     pp_seen_s, prepared_s, committed_s = tal.bit(0), tal.bit(1), tal.bit(2)
     honest_s, bcast_s = tal.bit(3), tal.bit(4)
@@ -290,6 +315,11 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     # extra unsort payload is ever needed for them.)
     c4 = counts_for_s(pp_seen_s)
     prep_hit_s = pp_seen_s & (c4 >= Q)
+    if crash_on:
+        # A down receiver can neither prepare nor commit (SPEC §6c) —
+        # masked here, not just frozen, so the telemetry counters below
+        # never report a quorum the trajectory didn't take.
+        prep_hit_s &= tal.bit(8)
     prep_new_s = prep_hit_s & ~prepared_s       # telemetry (DCE'd when off)
     prep_miss_s = pp_seen_s & ~prepared_s & ~prep_hit_s
     prepared2_s = prepared_s | prep_hit_s
@@ -297,6 +327,8 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     # ---- P5 commit tally.
     c5 = counts_for_s(prepared2_s)
     commit_now_s = prepared2_s & (c5 >= Q) & ~committed_s
+    if crash_on:
+        commit_now_s &= tal.bit(8)
     commit_miss_s = prepared2_s & ~committed_s & (c5 < Q)  # telemetry
 
     packed = tal.unsort(b32(prepared2_s) | (b32(commit_now_s) << 1))
@@ -324,6 +356,8 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
         imin_rows = jnp.stack(rows)                               # [2, S]
         imin = imin_rows[side]                                    # [N, S]
     adopt = (imin < N) & ~committed
+    if crash_on:
+        adopt &= up[:, None]   # down receivers adopt nothing (SPEC §6c)
     val_rows = dval[jnp.clip(imin_rows, 0, N - 1),
                     sarange[None, :]]                             # [1|2, S]
     vfull = (jnp.broadcast_to(val_rows, (N, S)) if no_part
@@ -336,14 +370,26 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
                       timer + 1)
 
+    if crash_on:
+        # SPEC §6c freeze: covers the state the masks above don't reach
+        # (a down node's pp_*/view/timer could still move from an up
+        # sender's broadcast or local timers).
+        (view, timer, pp_seen, pp_view, pp_val, prepared, committed,
+         dval) = freeze_down(
+            down, frozen, (view, timer, pp_seen, pp_view, pp_val,
+                           prepared, committed, dval))
+
     new = PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
-                    prepared, committed, dval)
+                    prepared, committed, dval, down)
     if not telem:
         return new
     cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    # view_changes clips at 0 like the dense kernel: a §6c recovery
+    # resets the view, and the raw delta would cancel real advances.
     vec = jnp.stack([cnt(prep_new_s), cnt(prep_miss_s), cnt(commit_now_s),
                      cnt(commit_miss_s), cnt(adopt),
-                     jnp.sum(view - st.view)])
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
     return new, vec
 
 
@@ -362,7 +408,7 @@ def _pspec(cfg: Config) -> PbftState:
     from ..parallel.mesh import NODE_AXIS as ND
     v, m = P(ND), P(ND, None)
     return PbftState(seed=P(), view=v, timer=v, pp_seen=m, pp_view=m,
-                     pp_val=m, prepared=m, committed=m, dval=m)
+                     pp_val=m, prepared=m, committed=m, dval=m, down=v)
 
 
 _ENGINE = None

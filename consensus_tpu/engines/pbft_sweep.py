@@ -162,8 +162,10 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
                       timer + 1)
 
+    # The f-sweep does not model SPEC §6c crashes (the CLI rejects
+    # --crash-prob with --f-sweep); the state's down mask rides unchanged.
     return PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
-                     prepared, committed, dval)
+                     prepared, committed, dval, st.down)
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -203,6 +205,14 @@ def pbft_fsweep_timed(cfg: Config, fs, repeats: int = 1):
     import time
 
     from ..network.runner import _sync_elem
+
+    if cfg.crash_cutoff > 0:
+        # The padded round kernel carries the down mask unchanged — a
+        # crashing config would silently simulate zero crashes (the
+        # same divergence Config rejects for the cpu engine).
+        raise ValueError("the pbft f-sweep does not implement the SPEC "
+                         "§6c crash-recover adversary; run per-f configs "
+                         "instead of --f-sweep with crash_prob > 0")
 
     def sync(st):
         # Timing policy matches time_tpu (benchmarks/run_benchmarks.py):

@@ -43,13 +43,16 @@ class RaftState(NamedTuple):
     timeout: jnp.ndarray    # [N] i32
     match_idx: jnp.ndarray  # [N, N] _match_dtype(L) — match_idx[l, j]
     next_idx: jnp.ndarray   # [N, N] _match_dtype(L)
+    down: jnp.ndarray       # [N] bool — SPEC §6c crashed mask
 
 
 # Shared kernels live in ops/ (SURVEY.md §7 package layout); the aliases
 # keep this module's call sites terse and preserve the original seams.
+from ..ops.adversary import CRASH_TELEMETRY, crash_counts, crash_transition
 from ..ops.adversary import bitcast_i32 as _i32
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import draw as _draw
+from ..ops.adversary import freeze_down as _freeze
 
 
 def _draw_timeout(seed, t_min, t_max, term, idx):
@@ -88,6 +91,7 @@ def raft_init(cfg: Config, seed) -> RaftState:
         timeout=_draw_timeout(seed, cfg.t_min, cfg.t_max, z, idx.astype(jnp.uint32)),
         match_idx=jnp.zeros((N, N), _match_dtype(L)),
         next_idx=jnp.ones((N, N), _match_dtype(L)),
+        down=jnp.zeros(N, bool),
     )
 
 
@@ -151,7 +155,8 @@ def _last_term(log_term, log_len):
 RAFT_TELEMETRY = ("leader_elections",    # candidates winning this round
                   "append_accepted",     # AppendEntries applied (log match)
                   "append_rejected",     # AppendEntries refused (mismatch)
-                  "entries_committed")   # Σ per-node commit-index advance
+                  "entries_committed",   # Σ per-node commit-index advance
+                  ) + CRASH_TELEMETRY    # SPEC §6c (zeros when disabled)
 
 
 def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False):
@@ -186,6 +191,30 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False):
     log_term, log_val, log_len = st.log_term, st.log_val, st.log_len
     commit, timer, timeout = st.commit, st.timer, st.timeout
     match_idx, next_idx = st.match_idx, st.next_idx
+    down = st.down
+
+    # SPEC §6c crash-recover adversary. crash_cutoff == 0 is a static
+    # config fact: the whole block traces away and the round program is
+    # the pre-§6c one (digest-neutral by construction, tests/test_crash.py).
+    crash_on = cfg.crash_cutoff > 0
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,
+            cfg.max_crashed)
+        up = ~down
+        # Volatile reset on recovery (rejoin from the persisted log):
+        # role/timer and leader bookkeeping are volatile; term, voted_for,
+        # log, commit survive. timeout is a pure function of (seed, term,
+        # id) and the term persisted, so it is definitionally unchanged.
+        role = jnp.where(rec, ROLE_F, role)
+        timer = jnp.where(rec, 0, timer)
+        match_idx = jnp.where(rec[:, None], jnp.asarray(0, mdt), match_idx)
+        next_idx = jnp.where(rec[:, None], jnp.asarray(1, mdt), next_idx)
+        # A down node neither sends nor receives...
+        deliver = deliver & up[:, None] & up[None, :]
+        # ...and its own state freezes at the post-reset value.
+        frozen = (term, role, voted_for, log_term, log_val, log_len,
+                  commit, timer, timeout, match_idx, next_idx)
 
     def bump(cond, new_term, term, role, voted_for, timeout):
         """SPEC §3 term-change rule where cond."""
@@ -366,14 +395,25 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False):
     # ---- P4 timers.
     timer = jnp.where(role == ROLE_L, 0, jnp.where(reset, timer, timer + 1))
 
+    if crash_on:
+        # SPEC §6c freeze: a down node's state is exactly its
+        # post-volatile-reset value — delivery masking already kept its
+        # (never-sent) messages out of everyone else's round.
+        (term, role, voted_for, log_term, log_val, log_len, commit,
+         timer, timeout, match_idx, next_idx) = _freeze(
+            down, frozen, (term, role, voted_for, log_term, log_val,
+                           log_len, commit, timer, timeout, match_idx,
+                           next_idx))
+
     new = RaftState(seed, term, role, voted_for, log_term, log_val, log_len,
-                    commit, timer, timeout, match_idx, next_idx)
+                    commit, timer, timeout, match_idx, next_idx, down)
     if not telem:
         return new
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
     vec = jnp.stack([jnp.sum(win.astype(jnp.int32)),
                      jnp.sum(apply_.astype(jnp.int32)),
                      jnp.sum(append_rej.astype(jnp.int32)),
-                     jnp.sum(commit - st.commit)])
+                     jnp.sum(commit - st.commit), *cz])
     return new, vec
 
 
@@ -394,7 +434,7 @@ def _raft_pspec(cfg: Config) -> RaftState:
     v, m = P(ND), P(ND, None)
     return RaftState(seed=P(), term=v, role=v, voted_for=v, log_term=m,
                      log_val=m, log_len=v, commit=v, timer=v, timeout=v,
-                     match_idx=m, next_idx=m)
+                     match_idx=m, next_idx=m, down=v)
 
 
 _ENGINE = None

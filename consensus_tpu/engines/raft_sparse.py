@@ -38,9 +38,11 @@ import jax.numpy as jnp
 from ..core import rng
 from ..core.config import Config
 from ..ops.adversary import bitcast_i32 as _i32
+from ..ops.adversary import crash_counts, crash_transition
 from ..ops.adversary import delivery_edges as _edges
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
+from ..ops.adversary import freeze_down as _freeze
 from .raft import (NONE, RAFT_TELEMETRY, ROLE_C, ROLE_F, ROLE_L,
                    _draw_timeout, _last_term, _match_dtype, _pick1, _pick_row)
 
@@ -79,6 +81,7 @@ class RaftSparseState(NamedTuple):
     lead_id: jnp.ndarray     # [A] i32 — tracked leader ids, NONE when empty
     lead_match: jnp.ndarray  # [A, N] _match_dtype(L)
     lead_next: jnp.ndarray   # [A, N] _match_dtype(L)
+    down: jnp.ndarray        # [N] bool — SPEC §6c crashed mask
 
 
 def raft_sparse_init(cfg: Config, seed) -> RaftSparseState:
@@ -96,6 +99,7 @@ def raft_sparse_init(cfg: Config, seed) -> RaftSparseState:
         lead_id=jnp.full(A, NONE, jnp.int32),
         lead_match=jnp.zeros((A, N), _match_dtype(L)),
         lead_next=jnp.ones((A, N), _match_dtype(L)),
+        down=jnp.zeros(N, bool),
     )
 
 
@@ -128,8 +132,15 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     ur = jnp.asarray(r, jnp.uint32)
     karange = jnp.arange(L, dtype=jnp.int32)[None, :]
 
+    crash_on = cfg.crash_cutoff > 0
+
     def dedge(src, dst):
-        return _edges(seed, ur, src, dst, cfg.drop_cutoff, cfg.partition_cutoff)
+        m = _edges(seed, ur, src, dst, cfg.drop_cutoff, cfg.partition_cutoff)
+        if crash_on:  # SPEC §6c: down nodes neither send nor receive
+            s = jnp.clip(jnp.asarray(src, jnp.int32), 0, N - 1)
+            d = jnp.clip(jnp.asarray(dst, jnp.int32), 0, N - 1)
+            m = m & up[s] & up[d]
+        return m
 
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
     # SPEC §3c byzantine minority — same masks as the dense kernel.
@@ -141,6 +152,21 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     log_term, log_val, log_len = st.log_term, st.log_val, st.log_len
     commit, timer, timeout = st.commit, st.timer, st.timeout
     lead_id, lead_match, lead_next = st.lead_id, st.lead_match, st.lead_next
+    down = st.down
+
+    # SPEC §6c crash-recover adversary — same semantics as the dense
+    # kernel: volatile reset on recovery (role/timer; the tracked-leader
+    # slot lifecycle below re-inits replication rows at re-election),
+    # delivery masked via dedge(), per-node state frozen while down.
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,
+            cfg.max_crashed)
+        up = ~down
+        role = jnp.where(rec, ROLE_F, role)
+        timer = jnp.where(rec, 0, timer)
+        frozen = (term, role, voted_for, log_term, log_val, log_len,
+                  commit, timer, timeout)
 
     def bump(cond, new_term, term, role, voted_for, timeout):
         term2 = jnp.where(cond, new_term, term)
@@ -171,6 +197,8 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     cand_mask = role == ROLE_C
     if withhold:
         cand_mask &= honest  # byz candidates never broadcast (SPEC §3c)
+    if crash_on:
+        cand_mask &= up      # down candidates send nothing (SPEC §6c)
     cand_ids = _top_active(cand_mask, term, idx, A)            # [A]
     cvalid = cand_ids >= 0
     cid = jnp.clip(cand_ids, 0, N - 1)
@@ -219,7 +247,12 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
 
     # ---- Tracked-leader slot lifecycle (SPEC §3b): rows follow ids;
     # entries (new winners or re-entries) get fresh election-time rows.
-    new_ids = _top_active(role == ROLE_L, term, idx, A)        # [A]
+    # Down leaders are untracked (they replicate nothing while crashed;
+    # on recovery they rejoin as followers — SPEC §6c).
+    lead_track = role == ROLE_L
+    if crash_on:
+        lead_track &= up
+    new_ids = _top_active(lead_track, term, idx, A)            # [A]
     same = new_ids[:, None] == jnp.where(lead_id[None, :] >= 0,
                                          lead_id[None, :], N + 1)  # [A, A]
     carried = jnp.any(same, axis=1) & (new_ids >= 0)
@@ -351,15 +384,25 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     # ---- P4 timers.
     timer = jnp.where(role == ROLE_L, 0, jnp.where(reset, timer, timer + 1))
 
+    if crash_on:
+        # SPEC §6c freeze: down nodes hold their post-volatile-reset
+        # state (lead_* slots never reference a down node — the tracked
+        # set above excludes them).
+        (term, role, voted_for, log_term, log_val, log_len, commit,
+         timer, timeout) = _freeze(
+            down, frozen, (term, role, voted_for, log_term, log_val,
+                           log_len, commit, timer, timeout))
+
     new = RaftSparseState(seed, term, role, voted_for, log_term, log_val,
                           log_len, commit, timer, timeout, lead_id,
-                          lead_match, lead_next)
+                          lead_match, lead_next, down)
     if not telem:
         return new
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
     vec = jnp.stack([jnp.sum(win.astype(jnp.int32)),
                      jnp.sum(apply_.astype(jnp.int32)),
                      jnp.sum(append_rej.astype(jnp.int32)),
-                     jnp.sum(commit - st.commit)])
+                     jnp.sum(commit - st.commit), *cz])
     return new, vec
 
 
@@ -379,7 +422,7 @@ def _pspec(cfg: Config) -> RaftSparseState:
     lm = P(None, ND)
     return RaftSparseState(seed=P(), term=v, role=v, voted_for=v, log_term=m,
                            log_val=m, log_len=v, commit=v, timer=v, timeout=v,
-                           lead_id=P(), lead_match=lm, lead_next=lm)
+                           lead_id=P(), lead_match=lm, lead_next=lm, down=v)
 
 
 _ENGINE = None

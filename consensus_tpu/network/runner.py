@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import os
 import pathlib
 import sys
 import time
@@ -214,8 +215,32 @@ def checkpoint_candidates(path) -> list:
     return out
 
 
+def _fsync_file(path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path) -> None:
+    # Directory fsync makes the rename itself durable; not every
+    # filesystem supports an O_RDONLY open+fsync on a directory — treat
+    # a refusal as "nothing to sync" rather than failing the save.
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(path, cfg: Config, carry, next_round: int,
-                    seeds=None, keep: int = 1) -> dict:
+                    seeds=None, keep: int = 1, fsync: bool = False) -> dict:
     """Snapshot the batched carry after ``next_round`` rounds have run.
 
     ``seeds`` records the per-sweep seed vector the carry was produced
@@ -228,6 +253,13 @@ def save_checkpoint(path, cfg: Config, carry, next_round: int,
     dropped). Every step is a single rename, so a kill at any point
     leaves only whole files — recovery never sees a half-rotated state
     worse than one missing rung.
+
+    ``fsync=True`` (docs/RESILIENCE.md §2b) additionally fsyncs the tmp
+    file's bytes BEFORE the renames and the directory entry AFTER them,
+    closing the power-loss window where a rename becomes durable while
+    the file content it points at never hit disk. Off by default: a
+    process kill (the common failure) can't produce that state, and on
+    network filesystems the sync can dominate the save.
 
     Returns ``{"bytes": npz_size, "wall_s": duration}`` — the concrete
     "measure first" numbers the ROADMAP's async-checkpoint item needs
@@ -258,11 +290,15 @@ def save_checkpoint(path, cfg: Config, carry, next_round: int,
         np.savez(tmp, __meta__=np.frombuffer(json.dumps(meta).encode(),
                                              dtype=np.uint8), **arrays)
         nbytes = tmp.stat().st_size
+        if fsync:
+            _fsync_file(tmp)
         for i in range(keep - 1, 0, -1):
             src = rotation_path(path, i - 1)
             if src.exists():
                 src.replace(rotation_path(path, i))
         tmp.replace(path)
+        if fsync:
+            _fsync_dir(path.parent)
         if sp is not None:
             sp["bytes"] = nbytes
     wall = time.perf_counter() - t0
@@ -378,8 +414,18 @@ def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None, *,
             # i32 -> u8); the saved integer values are identical, but
             # lax.scan requires the carry dtype to match what round_fn
             # returns.
+            tleaves = jax.tree.leaves(template)
+            if len(leaves) != len(tleaves):
+                # A carry schema from another era (e.g. a state field
+                # added since the snapshot was written — SPEC §6c's
+                # `down` mask). The saved trajectory is still valid but
+                # its pytree can't be unflattened into today's carry:
+                # treat as not-my-snapshot and try the next rotation.
+                _log_ckpt(f"{cand}: carry has {len(leaves)} leaves, "
+                          f"engine expects {len(tleaves)} — skipping")
+                continue
             leaves = [np.asarray(leaf).astype(t.dtype)
-                      for leaf, t in zip(leaves, jax.tree.leaves(template))]
+                      for leaf, t in zip(leaves, tleaves)]
             treedef = jax.tree.structure(template)
             nbytes = cand.stat().st_size
             wall = time.perf_counter() - t0
@@ -481,7 +527,7 @@ def _prepare(cfg: Config, eng: EngineDef, mesh, seeds=None):
 
 def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
              mesh, checkpoint_path=None, seeds=None, keep: int = 1,
-             telem=None, io: dict | None = None):
+             telem=None, io: dict | None = None, fsync: bool = False):
     """Drive fixed-shape jitted chunks from ``start`` to ``cfg.n_rounds``.
     Returns ``(carry, telem)`` — ``telem`` is the accumulated [B, K]
     telemetry counters, or None when telemetry is off.
@@ -515,7 +561,7 @@ def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
         r += n
         if checkpoint_path and r < cfg.n_rounds:
             rec = save_checkpoint(checkpoint_path, cfg, carry, r,
-                                  seeds=seeds, keep=keep)
+                                  seeds=seeds, keep=keep, fsync=fsync)
             if io is not None:
                 io["saves"] += 1
                 io["save_s"] += rec["wall_s"]
@@ -570,7 +616,7 @@ def _empty_io() -> dict:
 def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         resume: bool = False, stats: dict | None = None,
         seeds=None, keep_checkpoints: int = 2,
-        telemetry: bool = False) -> dict:
+        telemetry: bool = False, fsync_checkpoints: bool = False) -> dict:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
@@ -578,7 +624,9 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     common size + one for the ragged tail) and optionally checkpoints
     between them, rotating the last ``keep_checkpoints`` snapshots
     (default 2, so a torn latest file still leaves a valid fallback —
-    docs/RESILIENCE.md).
+    docs/RESILIENCE.md). ``fsync_checkpoints=True`` makes each snapshot
+    durable against power loss, not just process death (see
+    :func:`save_checkpoint`).
 
     If ``stats`` is given it is filled with ``start_round`` and
     ``executed_rounds`` so callers can report throughput for the rounds
@@ -603,6 +651,9 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     if telemetry and stats is None:
         raise ValueError("telemetry=True needs a stats dict to receive "
                          "the counters (stats['telemetry'])")
+    if fsync_checkpoints and not checkpoint_path:
+        raise ValueError("fsync_checkpoints=True without a checkpoint_path "
+                         "would be silently ignored (nothing is saved)")
     groups = _sweep_groups(cfg, seeds)
     if groups is not None:
         mesh = _check_groups(cfg, groups, mesh)
@@ -664,7 +715,8 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
              if telemetry else None)
     carry, telem = _advance(cfg, eng, carry, start, chunk, mesh,
                             checkpoint_path, seeds=np.asarray(seeds),
-                            keep=keep_checkpoints, telem=telem, io=io)
+                            keep=keep_checkpoints, telem=telem, io=io,
+                            fsync=fsync_checkpoints)
 
     if stats is not None:
         stats["executed_rounds"] = cfg.n_rounds - start

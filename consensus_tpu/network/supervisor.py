@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
 import time
 
 from ..core.config import Config
@@ -102,9 +103,11 @@ class RunReport:
 
 
 def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
-                   backoff_cap_s: float = 30.0, deadline_s: float | None = None,
+                   backoff_cap_s: float = 30.0, backoff_jitter: float = 0.25,
+                   jitter_rng=None, deadline_s: float | None = None,
                    fallback_cpu: bool = False, checkpoint_path=None,
-                   keep_checkpoints: int = 2, mesh=None, seeds=None,
+                   keep_checkpoints: int = 2, fsync_checkpoints: bool = False,
+                   mesh=None, seeds=None,
                    warmup: bool = False, telemetry: bool = False,
                    sleep=time.sleep):
     """Run ``cfg`` under supervision; return the :class:`RunResult` with
@@ -112,8 +115,15 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
 
     ``retries`` bounds re-attempts after transient failures (total
     attempts = retries + 1); between attempts the supervisor sleeps
-    ``backoff_s * 2**k`` (capped at ``backoff_cap_s``) and resumes from
-    the newest valid rotation of ``checkpoint_path`` (when given).
+    ``backoff_s * 2**k``, stretched by bounded multiplicative jitter —
+    a uniform factor in ``[1, 1 + backoff_jitter]`` — and capped at
+    ``backoff_cap_s``, then resumes from the newest valid rotation of
+    ``checkpoint_path`` (when given). The jitter decorrelates
+    co-scheduled retries (a fleet of sweeps knocked over by one tunnel
+    blip must not stampede the device in lockstep); pass a seeded
+    ``jitter_rng`` (``random.Random``) for deterministic delays in
+    tests, or ``backoff_jitter=0`` to disable. ``fsync_checkpoints``
+    passes through to the checkpoint writer (docs/RESILIENCE.md §2b).
     ``deadline_s`` is a wall-clock budget: no new attempt (or backoff
     sleep) starts past it. When everything is exhausted,
     ``fallback_cpu=True`` reruns the config on the CPU oracle engine —
@@ -138,9 +148,16 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     """
     if retries < 0:
         raise ValueError(f"retries must be >= 0, got {retries}")
+    if backoff_jitter < 0:
+        raise ValueError(f"backoff_jitter must be >= 0, got {backoff_jitter}")
     if fallback_cpu and cfg.engine != "tpu":
         raise ValueError("fallback_cpu degrades the tpu engine to the CPU "
                          f"oracle; cfg.engine={cfg.engine!r} already is it")
+    if fallback_cpu and cfg.crash_prob > 0:
+        raise ValueError(
+            "fallback_cpu cannot honor crash_prob > 0: the crash-recover "
+            "adversary (SPEC §6c) is not implemented by the CPU oracle, so "
+            "the degraded run would simulate different trajectories")
     if fallback_cpu and seeds is not None:
         raise ValueError(
             "fallback_cpu cannot honor an explicit seeds vector: the CPU "
@@ -158,6 +175,7 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     report = RunReport(retries=retries)
     t_start = time.monotonic()
     last_exc: BaseException | None = None
+    rng = jitter_rng if jitter_rng is not None else random.Random()
 
     for attempt in range(retries + 1):
         if deadline_s is not None and time.monotonic() - t_start >= deadline_s:
@@ -175,7 +193,8 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                 kw["telemetry"] = True
             if checkpoint_path:
                 kw.update(checkpoint_path=checkpoint_path, resume=True,
-                          keep_checkpoints=keep_checkpoints)
+                          keep_checkpoints=keep_checkpoints,
+                          fsync_checkpoints=fsync_checkpoints)
             if mesh is not None:
                 kw["mesh"] = mesh
             if seeds is not None:
@@ -200,7 +219,12 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                             error=repr(exc))
             last_exc = exc
             if attempt < retries:
-                delay = min(backoff_cap_s, backoff_s * (2 ** attempt))
+                # Bounded multiplicative jitter BEFORE the cap, so the
+                # cap stays a hard ceiling on the actual sleep.
+                delay = backoff_s * (2 ** attempt)
+                if backoff_jitter > 0:
+                    delay *= 1.0 + backoff_jitter * rng.random()
+                delay = min(backoff_cap_s, delay)
                 if deadline_s is not None:
                     delay = min(delay, max(
                         0.0, deadline_s - (time.monotonic() - t_start)))

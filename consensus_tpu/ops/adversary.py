@@ -61,6 +61,67 @@ def churn(seed, r, churn_cut: int):
     return draw(seed, rng.STREAM_CHURN, r, 0, 0) < cutoff(churn_cut)
 
 
+# SPEC §6c telemetry tail shared by every engine's counter vector: the
+# crash-recover adversary reports through the same round_telem path as
+# the protocol counters (zeros when crash_prob = 0).
+CRASH_TELEMETRY = ("crashes",      # nodes newly crashed this round
+                   "recoveries",   # nodes rejoining this round
+                   "nodes_down")   # Σ per-round down-node count
+
+
+def crash_transition(seed, r, down, crash_cut: int, recover_cut: int,
+                     max_crashed: int):
+    """SPEC §6c: advance the per-node down mask for round r.
+
+    Both draws are pure counter functions of (seed, round, node) —
+    STREAM_CRASH with c0 = 0 (crash) / 1 (recover) — so any round's
+    events can be recomputed anywhere; only the ``down`` mask itself is
+    history (it rides each engine's carry, so the cap can bind).
+    Order within the round: recoveries are decided on the start-of-round
+    down set; crashes on the post-recovery up set (a node may recover
+    and re-crash in one round — it re-enters with volatile state reset,
+    then freezes again). ``max_crashed > 0`` caps the simultaneously-
+    down count by admitting would-be crashers in ascending id order.
+
+    Returns ``(down', recovered, crashed)`` — the end-of-round mask and
+    this round's transition masks (telemetry + volatile-reset inputs).
+    """
+    N = down.shape[0]
+    ui = jnp.arange(N, dtype=jnp.uint32)
+    ur = jnp.asarray(r, jnp.uint32)
+    rec = down & (draw(seed, rng.STREAM_CRASH, ur, 1, ui)
+                  < cutoff(recover_cut))
+    still_down = down & ~rec
+    want = ~still_down & (draw(seed, rng.STREAM_CRASH, ur, 0, ui)
+                          < cutoff(crash_cut))
+    if max_crashed > 0:
+        base = jnp.sum(still_down.astype(jnp.int32))
+        rank = jnp.cumsum(want.astype(jnp.int32))
+        want = want & (base + rank <= max_crashed)
+    return still_down | want, rec, want
+
+
+def freeze_down(down, frozen, new_leaves):
+    """SPEC §6c freeze: leaf-wise ``where(down, frozen, new)``, with the
+    per-node mask broadcast over each leaf's trailing axes — a down
+    node's state holds its post-volatile-reset value no matter what the
+    round computed. Shared by every engine so the idiom can't drift."""
+    return tuple(
+        jnp.where(down.reshape(down.shape + (1,) * (n.ndim - 1)), o, n)
+        for o, n in zip(frozen, new_leaves))
+
+
+def crash_counts(crashed=None, rec=None, down=None):
+    """The :data:`CRASH_TELEMETRY` tail of an engine's counter vector:
+    (crashes, recoveries, nodes_down) this round — call with no args
+    for the adversary-off zeros."""
+    if crashed is None:
+        return (jnp.int32(0),) * 3
+    return (jnp.sum(crashed.astype(jnp.int32)),
+            jnp.sum(rec.astype(jnp.int32)),
+            jnp.sum(down.astype(jnp.int32)))
+
+
 def delivery_edges(seed, r, src, dst, drop_cut: int, part_cut: int):
     """SPEC §2 delivery evaluated on explicit (src, dst) edge id arrays.
 

@@ -48,7 +48,8 @@ def trace_raft_rounds(cfg, sweep: int | None = 0):
     def go(seed):
         def body(c, r):
             c2 = raft_round(cfg, c, r)
-            return c2, (c2.role, c2.term, c2.commit, c2.log_term, c2.log_val)
+            return c2, (c2.role, c2.term, c2.commit, c2.log_term, c2.log_val,
+                        c2.down)
         _, out = jax.lax.scan(body, raft_init(cfg, seed),
                               jnp.arange(cfg.n_rounds, dtype=jnp.int32))
         return out
@@ -58,7 +59,7 @@ def trace_raft_rounds(cfg, sweep: int | None = 0):
         out = jax.jit(jax.vmap(go, in_axes=0, out_axes=1))(jnp.asarray(seeds))
     else:
         out = jax.jit(go)(seeds[sweep])
-    role, term, commit, log_term, log_val = out
+    role, term, commit, log_term, log_val, down = out
     return {"role": np.asarray(role), "term": np.asarray(term),
             "commit": np.asarray(commit), "log_term": np.asarray(log_term),
-            "log_val": np.asarray(log_val)}
+            "log_val": np.asarray(log_val), "down": np.asarray(down)}

@@ -1,0 +1,261 @@
+"""SPEC §6c crash-recover adversary: per-node persistent/volatile state
+split across all six engines.
+
+Three contracts under test, per the acceptance criteria:
+
+  1. **Digest neutrality off** — `crash_prob = 0` must not perturb any
+     existing digest, for every engine, including scan_chunk /
+     sweep_chunk execution strategies (the crash block is a static
+     no-op when the cutoff is 0).
+  2. **Durability on** — with `crash_prob > 0`, durable state never
+     rolls back across a crash/recover cycle: raft commit indices and
+     committed log prefixes, pbft committed slots and decided values,
+     paxos learned values, dpos chains are monotone per round, per
+     node — even as nodes churn through crash/recover cycles.
+  3. **Determinism** — crash draws are pure counter functions of
+     (seed, round, node), so chunked/grouped execution of a crashing
+     run is bit-identical to the one-program run, and the telemetry
+     counters (crashes/recoveries/nodes_down) agree too.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.network import runner, simulator, supervisor
+
+from helpers import committed_prefixes_agree, run_cached, trace_raft_rounds
+
+ADV = dict(drop_rate=0.1, partition_rate=0.05, churn_rate=0.05)
+CRASH = dict(crash_prob=0.15, recover_prob=0.3)
+
+CFGS = {
+    "raft": Config(protocol="raft", n_nodes=5, n_rounds=48, n_sweeps=2,
+                   log_capacity=32, max_entries=16, **ADV),
+    "raft-sparse": Config(protocol="raft", n_nodes=16, max_active=4,
+                          n_rounds=40, n_sweeps=2, log_capacity=16,
+                          max_entries=8, **ADV),
+    "pbft": Config(protocol="pbft", f=1, n_nodes=4, n_rounds=24,
+                   log_capacity=8, **ADV),
+    "pbft-bcast": Config(protocol="pbft", fault_model="bcast", f=2,
+                         n_nodes=7, n_rounds=24, log_capacity=8, **ADV),
+    "paxos": Config(protocol="paxos", n_nodes=7, n_rounds=24,
+                    log_capacity=8, **ADV),
+    "dpos": Config(protocol="dpos", n_nodes=24, n_rounds=32,
+                   log_capacity=48, n_candidates=8, n_producers=3,
+                   epoch_len=8, **ADV),
+}
+
+
+def _crashed(cfg, **extra):
+    return dataclasses.replace(cfg, **{**CRASH, **extra})
+
+
+def _trace_rounds(cfg):
+    """Per-round extract() snapshots, [R, B, ...] — the monotonicity
+    probe (final states cannot show a mid-run rollback)."""
+    eng = simulator.engine_def(cfg)
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+
+    def go(seed):
+        def body(c, r):
+            c2 = eng.round_fn(cfg, c, r)
+            return c2, eng.extract(c2)
+        _, out = jax.lax.scan(body, eng.make_carry(cfg, seed),
+                              jnp.arange(cfg.n_rounds, dtype=jnp.int32))
+        return out
+
+    out = jax.jit(jax.vmap(go, in_axes=0, out_axes=1))(seeds)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+# --- 1. crash_prob = 0 is digest-neutral ------------------------------------
+
+@pytest.mark.parametrize("name", list(CFGS))
+def test_crash_off_is_digest_neutral(name):
+    """Explicitly-zero crash_prob (even with recover_prob/max_crashed
+    set) is bit-identical to the plain config — the §6c block must be a
+    static no-op, not a near-no-op."""
+    cfg = CFGS[name]
+    off = simulator.run(dataclasses.replace(
+        cfg, crash_prob=0.0, recover_prob=0.5, max_crashed=2), warmup=False)
+    assert off.payload == run_cached(cfg).payload
+
+
+@pytest.mark.parametrize("repl", [dict(scan_chunk=7), dict(sweep_chunk=1)],
+                         ids=["scan_chunk", "sweep_chunk"])
+@pytest.mark.parametrize("name", list(CFGS))
+def test_crash_off_neutral_under_chunking(name, repl):
+    cfg = dataclasses.replace(CFGS[name], crash_prob=0.0, recover_prob=0.5)
+    assert simulator.run(dataclasses.replace(cfg, **repl),
+                         warmup=False).payload == run_cached(
+        CFGS[name]).payload
+
+
+# --- 2. durable state never rolls back --------------------------------------
+
+def _assert_prefix_stable(count, vals, what):
+    """vals[r, b, i, :count[r, b, i]] must be unchanged at r+1."""
+    R = count.shape[0]
+    L = vals.shape[-1]
+    karange = np.arange(L)
+    for r in range(R - 1):
+        mask = karange[None, None, :] < count[r][..., None]
+        np.testing.assert_array_equal(
+            np.where(mask, vals[r], 0), np.where(mask, vals[r + 1], 0),
+            err_msg=f"{what}: decided prefix changed after round {r}")
+
+
+@pytest.mark.parametrize("name", ["raft", "raft-sparse"])
+def test_raft_commit_durable_across_crashes(name):
+    cfg = _crashed(CFGS[name])
+    tr = _trace_rounds(cfg)
+    assert (np.diff(tr["commit"], axis=0) >= 0).all(), \
+        "commit index rolled back across a crash/recover cycle"
+    _assert_prefix_stable(tr["commit"], tr["log_val"], name)
+    _assert_prefix_stable(tr["commit"], tr["log_term"], name)
+
+
+@pytest.mark.parametrize("name", ["pbft", "pbft-bcast"])
+def test_pbft_committed_durable_across_crashes(name):
+    cfg = _crashed(CFGS[name])
+    tr = _trace_rounds(cfg)
+    com = tr["committed"]
+    assert (com[:-1] <= com[1:]).all(), "a committed slot un-committed"
+    for r in range(cfg.n_rounds - 1):
+        np.testing.assert_array_equal(
+            np.where(com[r], tr["dval"][r], 0),
+            np.where(com[r], tr["dval"][r + 1], 0),
+            err_msg=f"{name}: decided value changed after round {r}")
+
+
+def test_paxos_learned_durable_across_crashes():
+    cfg = _crashed(CFGS["paxos"])
+    tr = _trace_rounds(cfg)
+    lm = tr["learned_mask"]
+    assert (lm[:-1] <= lm[1:]).all(), "a learned slot was forgotten"
+    for r in range(cfg.n_rounds - 1):
+        np.testing.assert_array_equal(
+            np.where(lm[r], tr["learned_val"][r], 0),
+            np.where(lm[r], tr["learned_val"][r + 1], 0),
+            err_msg=f"learned value changed after round {r}")
+
+
+def test_dpos_chain_durable_across_crashes():
+    cfg = _crashed(CFGS["dpos"])
+    tr = _trace_rounds(cfg)
+    assert (np.diff(tr["chain_len"], axis=0) >= 0).all()
+    _assert_prefix_stable(tr["chain_len"], tr["chain_p"], "dpos chain_p")
+    _assert_prefix_stable(tr["chain_len"], tr["chain_r"], "dpos chain_r")
+
+
+def test_paxos_no_conflicting_learned_values():
+    """Agreement survives the promise-bookkeeping reset (SPEC §6c's
+    volatility argument: ballots strictly increase across rounds, so a
+    forgotten promise can never admit a lower ballot)."""
+    cfg = _crashed(CFGS["paxos"])
+    res = simulator.run(cfg, warmup=False)
+    # pack_sparse decided records: rec_a = slot ids, rec_b = values.
+    for b in range(cfg.n_sweeps):
+        slot_val: dict[int, int] = {}
+        for i in range(cfg.n_nodes):
+            c = int(res.counts[b, i])
+            for s, v in zip(res.rec_a[b, i, :c], res.rec_b[b, i, :c]):
+                assert slot_val.setdefault(int(s), int(v)) == int(v), \
+                    f"sweep {b}: two learned values for slot {s}"
+
+
+def test_raft_state_machine_safety_under_crashes():
+    cfg = _crashed(CFGS["raft"])
+    res = simulator.run(cfg, warmup=False)
+    for b in range(cfg.n_sweeps):
+        assert committed_prefixes_agree(res, list(range(cfg.n_nodes)), b)
+
+
+# --- 3. determinism: chunking + telemetry -----------------------------------
+
+@pytest.mark.parametrize("repl", [dict(scan_chunk=7), dict(sweep_chunk=1)],
+                         ids=["scan_chunk", "sweep_chunk"])
+def test_crashing_run_invariant_to_chunking(repl):
+    cfg = _crashed(CFGS["raft"])
+    base = simulator.run(cfg, warmup=False, telemetry=True, stats={})
+    got = simulator.run(dataclasses.replace(cfg, **repl), warmup=False,
+                        telemetry=True, stats={})
+    assert got.payload == base.payload
+    for k, v in base.extras["telemetry"]["per_sweep"].items():
+        np.testing.assert_array_equal(
+            got.extras["telemetry"]["per_sweep"][k], v, err_msg=k)
+
+
+@pytest.mark.parametrize("name", list(CFGS))
+def test_crash_telemetry_counters_flow(name):
+    cfg = _crashed(CFGS[name])
+    res = simulator.run(cfg, warmup=False, telemetry=True, stats={})
+    t = res.extras["telemetry"]["totals"]
+    assert t["crashes"] > 0, "adversary enabled but nobody ever crashed"
+    # Every recovery needs a prior crash; every crash is down >= 1 round.
+    assert t["recoveries"] <= t["crashes"] <= t["nodes_down"]
+
+
+def test_crash_telemetry_zero_when_disabled():
+    res = simulator.run(CFGS["raft"], warmup=False, telemetry=True, stats={})
+    t = res.extras["telemetry"]["totals"]
+    assert t["crashes"] == t["recoveries"] == t["nodes_down"] == 0
+
+
+def test_max_crashed_caps_simultaneous_downs():
+    cfg = _crashed(CFGS["raft"], crash_prob=0.9, recover_prob=0.05,
+                   max_crashed=2)
+    tr = trace_raft_rounds(cfg, None)
+    per_round_down = tr["down"].sum(axis=2)          # [R, B]
+    assert per_round_down.max() <= 2
+    assert per_round_down.max() == 2, "cap never reached — test is vacuous"
+
+
+def test_crash_checkpoint_resume_bit_identical(tmp_path):
+    """The execution-layer and protocol-layer fault models compose: a
+    checkpointed crashing run resumes bit-identically (the down mask
+    rides the carry through the snapshot)."""
+    cfg = _crashed(CFGS["raft"], scan_chunk=8)
+    base = simulator.run(cfg, warmup=False)
+    ck = tmp_path / "ck.npz"
+    eng = simulator.engine_def(cfg)
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    carry = runner._init_jit(cfg, eng, seeds)
+    carry = runner._chunk_jit(cfg, eng, 16, carry, jnp.int32(0))
+    runner.save_checkpoint(ck, cfg, carry, 16)
+    resumed = simulator.run(cfg, warmup=False, checkpoint_path=str(ck),
+                            resume=True, stats=(stats := {}))
+    assert stats["start_round"] == 16
+    assert resumed.payload == base.payload
+
+
+# --- config / CLI surface ----------------------------------------------------
+
+def test_config_rejects_crash_on_cpu_engine():
+    with pytest.raises(ValueError, match="crash_prob"):
+        Config(protocol="raft", engine="cpu", crash_prob=0.1)
+
+
+def test_config_rejects_bad_max_crashed():
+    with pytest.raises(ValueError, match="max_crashed"):
+        Config(protocol="raft", n_nodes=5, max_crashed=6)
+    with pytest.raises(ValueError, match="max_crashed"):
+        Config(protocol="raft", n_nodes=5, max_crashed=-1)
+
+
+def test_supervisor_rejects_fallback_cpu_with_crashes():
+    with pytest.raises(ValueError, match="crash"):
+        supervisor.supervised_run(_crashed(CFGS["raft"]), fallback_cpu=True)
+
+
+def test_config_json_roundtrips_crash_fields():
+    cfg = _crashed(CFGS["raft"], max_crashed=3)
+    assert Config.from_json(cfg.to_json()) == cfg
+    # Pre-§6c config dicts load with the adversary off.
+    old = {"protocol": "raft", "n_nodes": 5}
+    cfg2 = Config.from_json(__import__("json").dumps(old))
+    assert cfg2.crash_prob == 0.0 and cfg2.max_crashed == 0

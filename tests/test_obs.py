@@ -319,6 +319,53 @@ def test_cli_artifacts_validate_and_digest_stable(tmp_path, capsys):
     assert doc["metrics"]["dispatch_wall_s"]["count"] >= 4  # 32/8 chunks
 
 
+def test_cli_crash_adversary_artifacts_validate(tmp_path, capsys):
+    """A fresh CLI run with the SPEC §6c crash-recover adversary enabled
+    must emit artifacts the validator accepts — including the new
+    telemetry counter names (crashes/recoveries/nodes_down) in the CLI
+    report, checked against the validator's known-name registry."""
+    from consensus_tpu import cli
+    trace = tmp_path / "run.trace.jsonl"
+    metrics = tmp_path / "metrics.json"
+    rc = cli.main(["--protocol", "raft", "--nodes", "5", "--rounds", "32",
+                   "--sweeps", "2", "--log-capacity", "16",
+                   "--max-entries", "8", "--drop-rate", "0.1",
+                   "--crash-prob", "0.2", "--recover-prob", "0.3",
+                   "--max-crashed", "2", "--engine", "tpu",
+                   "--scan-chunk", "8", "--telemetry",
+                   "--trace-out", str(trace), "--metrics-out", str(metrics)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["telemetry"]["crashes"] > 0
+    assert report["telemetry"]["nodes_down"] >= report["telemetry"]["crashes"]
+    cli_report = tmp_path / "report.json"
+    cli_report.write_text(json.dumps(report))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "validate_trace.py"),
+         "--trace", str(trace), "--metrics", str(metrics),
+         "--cli-report", str(cli_report)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_validator_flags_unknown_telemetry_counter(tmp_path):
+    v = _load_validator()
+    good = tmp_path / "r.json"
+    good.write_text(json.dumps({
+        "protocol": "raft", "engine": "tpu", "digest": "d", "steps": 1,
+        "wall_s": 0.1, "payload_bytes": 8,
+        "telemetry": {"crashes": 0, "recoveries": 0, "nodes_down": 0}}))
+    assert v.validate_cli_report(good) == []
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "protocol": "raft", "engine": "tpu", "digest": "d", "steps": 1,
+        "wall_s": 0.1, "payload_bytes": 8,
+        "telemetry": {"crashez": 1, "crashes": -1}}))
+    errs = v.validate_cli_report(bad)
+    assert any("crashez" in e for e in errs)
+    assert any("crashes" in e and ">= 0" in e for e in errs)
+
+
 def test_cli_artifacts_exclude_warmup(tmp_path, capsys):
     """The hidden warmup pass (compile) must not pollute exported
     artifacts: dispatch_wall_s counts exactly the timed run's chunks,

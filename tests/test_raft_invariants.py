@@ -96,6 +96,74 @@ def test_leader_completeness(cfg):
                 pref_v = lv[r, b, j, :cmax].copy()
 
 
+# --- safety while nodes churn through crash/recover cycles (SPEC §6c) -------
+#
+# Election Safety and Log Matching are checked on the LIVE set: a node
+# frozen mid-crash legitimately still shows its pre-crash role/log, but
+# among reachable nodes the invariants must hold exactly as in the
+# honest runs above — voted_for and the log are §6c-durable, so a
+# recovered node can neither double-vote in a term it already voted in
+# nor resurrect truncated entries.
+
+CRASH_CFGS = [
+    Config(protocol="raft", n_nodes=5, n_rounds=96, log_capacity=128,
+           max_entries=100, n_sweeps=4, seed=404,
+           drop_rate=0.2, churn_rate=0.1, crash_prob=0.15, recover_prob=0.3),
+    Config(protocol="raft", n_nodes=9, n_rounds=96, log_capacity=128,
+           max_entries=100, n_sweeps=3, seed=505, drop_rate=0.3,
+           partition_rate=0.1, crash_prob=0.2, recover_prob=0.25,
+           max_crashed=4),
+]
+
+
+@pytest.mark.parametrize("cfg", CRASH_CFGS)
+def test_election_safety_live_set_under_crashes(cfg):
+    """At most one LIVE leader per term, every round, while nodes crash
+    and recover — the invariant a volatile voted_for would break (a
+    rejoining node that forgot its vote could elect a second leader)."""
+    tr = trace_raft_rounds(cfg, None)
+    crashed_rounds = tr["down"].any(axis=(0, 2))
+    assert crashed_rounds.all(), "adversary never fired — test is vacuous"
+    for b in range(cfg.n_sweeps):
+        winners: dict[int, set[int]] = {}
+        for r in range(cfg.n_rounds):
+            live_lead = (tr["role"][r, b] == 2) & ~tr["down"][r, b]
+            for i in np.nonzero(live_lead)[0]:
+                winners.setdefault(int(tr["term"][r, b, i]), set()).add(int(i))
+        multi = {t: w for t, w in winners.items() if len(w) > 1}
+        assert not multi, f"sweep {b}: two live leaders in a term: {multi}"
+
+
+@pytest.mark.parametrize("cfg", CRASH_CFGS)
+def test_log_matching_live_set_under_crashes(cfg):
+    """Log Matching over every round's live set: entries with the same
+    (index, term) are identical across every pair of reachable logs,
+    sampled at rounds 1/4, 1/2, 3/4 and the final round."""
+    tr = trace_raft_rounds(cfg, None)
+    for b in range(cfg.n_sweeps):
+        for r in {cfg.n_rounds // 4, cfg.n_rounds // 2,
+                  3 * cfg.n_rounds // 4, cfg.n_rounds - 1}:
+            live = np.nonzero(~tr["down"][r, b])[0]
+            lt, lv = tr["log_term"][r, b], tr["log_val"][r, b]
+            for a, i in enumerate(live):
+                for j in live[a + 1:]:
+                    same = (lt[i] == lt[j]) & (lt[i] != 0)
+                    np.testing.assert_array_equal(
+                        lv[i][same], lv[j][same],
+                        err_msg=f"sweep {b} round {r}: log-matching "
+                                f"violation {i}/{j}")
+
+
+@pytest.mark.parametrize("cfg", CRASH_CFGS)
+def test_state_machine_safety_under_crashes(cfg):
+    """Committed prefixes agree across ALL nodes — including frozen
+    ones, whose prefix is a (durable) earlier commit of the same log."""
+    res = run_cached(cfg)
+    for b in range(cfg.n_sweeps):
+        assert committed_prefixes_agree(res, list(range(cfg.n_nodes)), b), \
+            f"sweep {b}: committed prefix divergence under crashes"
+
+
 def test_partitioned_minority_cannot_commit():
     """With a permanent-ish partition pattern, committed entries never exceed
     what a majority could replicate: commit counts stay consistent (safety

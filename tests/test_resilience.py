@@ -30,6 +30,10 @@ from consensus_tpu.network import faults, runner, simulator, supervisor
 CFG = Config(protocol="raft", n_nodes=5, n_rounds=48, n_sweeps=2,
              log_capacity=16, max_entries=8, scan_chunk=8,
              drop_rate=0.1, churn_rate=0.05)
+# The same run under the SPEC §6c crash-recover adversary: the
+# execution-layer fault model (kills, retries, torn snapshots) must
+# compose with the protocol-layer one (simulated node crashes).
+CRASH_CFG = dataclasses.replace(CFG, crash_prob=0.15, recover_prob=0.3)
 
 
 @pytest.fixture(autouse=True)
@@ -177,6 +181,46 @@ def test_runner_run_keeps_k_checkpoints(tmp_path):
     assert rounds == [40, 32, 24]
 
 
+# --- fsync durability (tier-1) ----------------------------------------------
+
+def test_fsync_checkpoints_flag_roundtrips(tmp_path, monkeypatch):
+    """--fsync-checkpoints: the synced snapshot loads back verbatim,
+    os.fsync actually ran (file + directory), and the default path
+    issues NO fsync at all (unchanged behavior)."""
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(runner.os, "fsync",
+                        lambda fd: (synced.append(fd), real_fsync(fd))[1])
+    ck = tmp_path / "ck.npz"
+    eng = raft.get_engine()
+    seeds = jnp.asarray(runner.make_seeds(CFG))
+    carry = runner._chunk_jit(CFG, eng, 8, runner._init_jit(CFG, eng, seeds),
+                              jnp.int32(0))
+    runner.save_checkpoint(ck, CFG, carry, 8)          # default: no fsync
+    assert synced == []
+    runner.save_checkpoint(ck, CFG, carry, 8, fsync=True)
+    assert len(synced) == 2                            # tmp file + directory
+    assert runner.load_checkpoint(ck, CFG, eng)[1] == 8
+
+    base = runner.run(CFG, eng)
+    ck2 = tmp_path / "ck2.npz"
+    out = runner.run(CFG, eng, checkpoint_path=ck2, fsync_checkpoints=True)
+    for k in base:
+        np.testing.assert_array_equal(base[k], out[k], err_msg=k)
+    with pytest.raises(ValueError, match="fsync"):
+        runner.run(CFG, eng, fsync_checkpoints=True)   # no checkpoint_path
+
+
+def test_cli_fsync_requires_checkpoint(tmp_path, capsys):
+    cli, flags = _cli_flags(extra=["--fsync-checkpoints"])
+    with pytest.raises(SystemExit):
+        cli.main(flags)
+    cli2, flags2 = _cli_flags(tmp_path / "ck.npz", ["--fsync-checkpoints"])
+    assert cli2.main(flags2) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["digest"] == simulator.run(CFG, warmup=False).digest
+
+
 # --- supervisor (tier-1) -----------------------------------------------------
 
 def test_supervisor_retries_transient_and_resumes(tmp_path):
@@ -199,6 +243,57 @@ def test_supervisor_retries_transient_and_resumes(tmp_path):
     # A resumed run executes only the remaining rounds.
     assert res.node_round_steps == \
         CFG.n_sweeps * CFG.n_nodes * (CFG.n_rounds - 16)
+
+
+def test_supervisor_backoff_jitter_bounded_and_seedable():
+    """Backoff sleeps carry bounded multiplicative jitter — inside
+    [base·2^k, base·2^k·(1+jitter)], deterministic for a seeded rng —
+    so co-scheduled retries don't synchronize (docs/RESILIENCE.md)."""
+    import random
+
+    def delays_for(seed, jitter=0.25):
+        faults.install(transient_dispatches=[1, 2, 3])
+        got = []
+        with pytest.raises(supervisor.SupervisorError):
+            supervisor.supervised_run(CFG, retries=2, backoff_s=0.5,
+                                      backoff_jitter=jitter,
+                                      jitter_rng=random.Random(seed),
+                                      sleep=got.append)
+        return got
+
+    d = delays_for(7)
+    assert len(d) == 2
+    assert 0.5 <= d[0] <= 0.5 * 1.25 and 1.0 <= d[1] <= 1.0 * 1.25
+    assert d == delays_for(7)                   # seeded ⇒ reproducible
+    assert d != delays_for(8)                   # ...and actually jittered
+    assert delays_for(7, jitter=0.0) == [0.5, 1.0]  # opt-out: exact ladder
+    with pytest.raises(ValueError, match="backoff_jitter"):
+        supervisor.supervised_run(CFG, backoff_jitter=-0.1)
+
+
+def test_supervisor_backoff_jitter_respects_cap():
+    import random
+    faults.install(transient_dispatches=[1, 2])
+    got = []
+    with pytest.raises(supervisor.SupervisorError):
+        supervisor.supervised_run(CFG, retries=1, backoff_s=10.0,
+                                  backoff_cap_s=1.0, backoff_jitter=0.5,
+                                  jitter_rng=random.Random(3),
+                                  sleep=got.append)
+    assert got == [1.0]  # the cap is a hard ceiling, jitter included
+
+
+def test_supervisor_resumes_crashing_run_bit_identical(tmp_path):
+    """Fault-model composition, in-process: a transient failure mid-way
+    through a run WITH the §6c adversary retries, resumes (the down
+    mask rides the snapshot), and lands on the uninterrupted digest."""
+    base = simulator.run(CRASH_CFG, warmup=False)
+    faults.install(transient_dispatches=[3])
+    res = supervisor.supervised_run(CRASH_CFG, retries=2, backoff_s=0,
+                                    checkpoint_path=tmp_path / "ck.npz",
+                                    sleep=lambda s: None)
+    assert res.digest == base.digest
+    assert res.extras["run_report"]["resumed_from_round"] == 16
 
 
 def test_supervisor_gives_up_after_retries(tmp_path):
@@ -291,12 +386,14 @@ def test_is_transient_classification():
 
 # --- CLI integration (tier-1) ------------------------------------------------
 
-def _cli_flags(ck=None, extra=()):
+def _cli_flags(ck=None, extra=(), crash=False):
     from consensus_tpu import cli
     flags = ["--protocol", "raft", "--nodes", "5", "--rounds", "48",
              "--sweeps", "2", "--log-capacity", "16", "--max-entries", "8",
              "--scan-chunk", "8", "--drop-rate", "0.1",
              "--churn-rate", "0.05", "--engine", "tpu", "--platform", "cpu"]
+    if crash:  # the SPEC §6c adversary, matching CRASH_CFG
+        flags += ["--crash-prob", "0.15", "--recover-prob", "0.3"]
     if ck is not None:
         flags += ["--checkpoint", str(ck)]
     return cli, flags + list(extra)
@@ -342,8 +439,8 @@ def test_cli_rejects_supervision_with_fsweep_and_profile(tmp_path):
 
 # --- subprocess crash injection (slow tier) ----------------------------------
 
-def _spawn_cli(ck, fault_plan=None, extra=()):
-    cli, flags = _cli_flags(ck, extra)
+def _spawn_cli(ck, fault_plan=None, extra=(), crash=False):
+    cli, flags = _cli_flags(ck, extra, crash=crash)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     if fault_plan is not None:
         env[faults.ENV_VAR] = json.dumps(fault_plan)
@@ -382,6 +479,23 @@ def test_sigkill_midrun_then_resume_is_bit_identical(tmp_path):
     res2 = supervisor.supervised_run(CFG, checkpoint_path=ck, retries=0)
     assert res2.digest == base.digest
     assert res2.extras["run_report"]["resumed_from_round"] == fell_back_to
+
+
+@pytest.mark.slow
+def test_sigkill_midrun_with_crash_adversary_is_bit_identical(tmp_path):
+    """Fault-model composition, end to end: a CLI run WITH the §6c
+    crash-recover adversary is SIGKILLed after chunk 2; the resumed run
+    must be bit-identical to an uninterrupted one — the down mask and
+    every frozen node's state ride the verified snapshot."""
+    ck = tmp_path / "ck.npz"
+    p = _spawn_cli(ck, fault_plan={"kill_after_chunk": 2}, crash=True,
+                   extra=["--fsync-checkpoints"])
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr)
+    assert runner.peek_checkpoint(ck, CRASH_CFG) == 16
+    base = simulator.run(CRASH_CFG, warmup=False)
+    res = supervisor.supervised_run(CRASH_CFG, checkpoint_path=ck, retries=0)
+    assert res.digest == base.digest
+    assert res.extras["run_report"]["resumed_from_round"] == 16
 
 
 @pytest.mark.slow

@@ -23,6 +23,26 @@ import sys
 TRACE_VERSION = 1
 METRICS_VERSION = 1
 
+# Every on-device protocol telemetry counter any engine may report
+# (docs/OBSERVABILITY.md §"Telemetry"); a CLI report's `telemetry` keys
+# must come from this set — an unknown name means the engines and this
+# tripwire have drifted. Duplicated here by design: this tool must stay
+# import-free of the framework (no jax at CI time).
+TELEMETRY_COUNTERS = frozenset({
+    # raft (dense + sparse)
+    "leader_elections", "append_accepted", "append_rejected",
+    "entries_committed",
+    # pbft (edge + bcast)
+    "prepare_quorums", "prepare_missed", "commit_quorums", "commit_missed",
+    "commits_adopted", "view_changes",
+    # paxos
+    "promises", "nacks", "accepts", "proposals_decided", "values_learned",
+    # dpos
+    "blocks_appended", "missed_appends", "producer_rotations", "churn_slots",
+    # crash-recover adversary (SPEC §6c, every engine)
+    "crashes", "recoveries", "nodes_down",
+})
+
 _SCALAR = (bool, int, float, str, type(None))
 
 
@@ -176,6 +196,35 @@ def validate_report(path) -> list:
     return errs
 
 
+def validate_cli_report(path) -> list:
+    """Checks for the CLI's one-line JSON run report (saved stdout),
+    including the telemetry counter-name registry."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable/not JSON: {exc}"]
+    if not isinstance(doc, dict):
+        return [f"{path}: top level must be an object"]
+    errs = []
+    for key in ("protocol", "engine", "digest", "steps", "wall_s",
+                "payload_bytes"):
+        if key not in doc:
+            errs.append(f"{path}: missing key {key!r}")
+    tel = doc.get("telemetry")
+    if tel is None:
+        return errs
+    if not isinstance(tel, dict):
+        return errs + [f"{path}: 'telemetry' must be an object"]
+    for name, v in tel.items():
+        if name not in TELEMETRY_COUNTERS:
+            errs.append(f"{path}: telemetry counter {name!r} is not in the "
+                        "known-name registry (engines and validator "
+                        "drifted?)")
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{path}: telemetry {name} must be an int >= 0")
+    return errs
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Validate trace JSONL / metrics JSON / RunReport "
@@ -183,9 +232,14 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", default="", help="span/event JSONL file")
     ap.add_argument("--metrics", default="", help="metrics snapshot JSON")
     ap.add_argument("--report", default="", help="RunReport JSON")
+    ap.add_argument("--cli-report", default="",
+                    help="the CLI's one-line JSON run report (saved "
+                         "stdout); telemetry counter names are checked "
+                         "against the known-name registry")
     args = ap.parse_args(argv)
-    if not (args.trace or args.metrics or args.report):
-        ap.error("nothing to validate: pass --trace/--metrics/--report")
+    if not (args.trace or args.metrics or args.report or args.cli_report):
+        ap.error("nothing to validate: pass --trace/--metrics/--report/"
+                 "--cli-report")
     errs = []
     if args.trace:
         errs += validate_trace(args.trace)
@@ -193,6 +247,8 @@ def main(argv=None) -> int:
         errs += validate_metrics(args.metrics)
     if args.report:
         errs += validate_report(args.report)
+    if args.cli_report:
+        errs += validate_cli_report(args.cli_report)
     for e in errs:
         print(f"validate_trace: {e}", file=sys.stderr)
     if errs:
