@@ -48,7 +48,21 @@ def log(msg):
 
 def timed_scan(cfg, round_fn, seeds, n_rounds, tag, repeats=3,
                trace_dir=None):
-    """Scan `round_fn` (cfg-bound) over n_rounds, vmapped over sweeps."""
+    """Scan `round_fn` (cfg-bound) over n_rounds, vmapped over sweeps.
+
+    Cache-proof (ROADMAP "Tunnel-cache audit", ADVICE r5): the tunnel
+    backend caches byte-identical dispatches, so re-dispatching the same
+    seed vector can replay a cached result and overstate steps/sec —
+    exactly what PR 1 fixed for the full-run timings
+    (benchmarks/run_benchmarks.py time_tpu). Each timed repeat therefore
+    runs a DIFFERENT seed vector, offset by (rep+1)*n_sweeps — the same
+    lo32(seed + b) lattice the runner derives, shifted past every
+    trajectory any other repeat dispatched. The kernels are branchless
+    with seed-independent shapes, so per-seed work (and throughput) is
+    identical across repeats; any digest/sanity read still comes from
+    the base-seed warmup state (`seeds` as passed in), which is also
+    what the optional profiler trace captures.
+    """
 
     @jax.jit
     def prog(seeds):
@@ -68,16 +82,22 @@ def timed_scan(cfg, round_fn, seeds, n_rounds, tag, repeats=3,
         # plugin); a host transfer is the only reliable barrier.
         return np.asarray(o.commit).sum()
 
-    sync(prog(seeds))  # compile + warm
+    sync(prog(seeds))  # compile + warm; base-seed state
     if trace_dir is not None:
         # Trace only a steady-state execution — tracing the compile
-        # drowns the device timeline in host-side jaxpr events.
+        # drowns the device timeline in host-side jaxpr events. The
+        # traced dispatch reuses the warm base-seed input: a cache
+        # replay would show up as an empty device timeline, which is
+        # self-diagnosing, and the trace should depict the same state
+        # the digest describes.
         with jax.profiler.trace(str(trace_dir)):
             sync(prog(seeds))
     best = float("inf")
-    for _ in range(repeats):
+    for rep in range(repeats):
+        # lo32 wrap-around matches the runner's seed lattice exactly.
+        varied = seeds + jnp.uint32((rep + 1) * seeds.shape[0])
         t0 = time.perf_counter()
-        sync(prog(seeds))
+        sync(prog(varied))
         best = min(best, time.perf_counter() - t0)
     steps = seeds.shape[0] * cfg.n_nodes * n_rounds
     log(f"{tag:28s} {best:7.3f}s  {steps / best / 1e6:7.2f}M steps/s")

@@ -70,7 +70,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "(sweep x node); TPU engine only")
     ap.add_argument("--checkpoint", default="",
                     help="checkpoint file; resumes from the newest valid "
-                         "(checksum-verified) rotation if present")
+                         "(checksum-verified) rotation if present. "
+                         "Snapshots are written by a double-buffered "
+                         "background writer so the chunk loop never waits "
+                         "on IO (docs/PERF.md; --sync-checkpoints opts out)")
+    ap.add_argument("--sync-checkpoints", action="store_true",
+                    help="write each snapshot synchronously on the chunk "
+                         "loop (the pre-async behavior) instead of the "
+                         "default background double-buffered writer; "
+                         "bit-identical results and on-disk bytes either "
+                         "way — this only trades hot-path stall for "
+                         "zero writer concurrency; requires --checkpoint")
     ap.add_argument("--fsync-checkpoints", action="store_true",
                     help="fsync each snapshot's bytes before (and its "
                          "directory entry after) the atomic rename, making "
@@ -233,6 +243,7 @@ def main(argv=None) -> int:
             ("--mesh" if "mesh" in typed else "config field mesh_shape",
              "mesh" in typed or cfg.mesh_shape),
             ("--checkpoint", args.checkpoint),
+            ("--sync-checkpoints", args.sync_checkpoints),
             ("--fsync-checkpoints", args.fsync_checkpoints),
             ("--keep-checkpoints", "keep_checkpoints" in typed),
             ("--retries", args.retries),
@@ -254,9 +265,12 @@ def main(argv=None) -> int:
     # Usage errors must fail fast — before any accelerator probe.
     if args.checkpoint and cfg.sweep_chunk and cfg.sweep_chunk < cfg.n_sweeps:
         parser.error("--checkpoint is not supported with sweep_chunk "
-                     "grouping (one snapshot per group is not a layout "
-                     "anything resumes); use --scan-chunk for mid-run "
-                     "snapshots or drop --sweep-chunk")
+                     "grouping (one rotation set cannot hold N groups' "
+                     "snapshots); use --scan-chunk for mid-run snapshots "
+                     "or drop --sweep-chunk. The per-group layout exists "
+                     "as groundwork — runner.run(group_dir=...) writes "
+                     "group subdirectories + a completed-group manifest; "
+                     "supervisor-driven grouped resume is a future PR")
     keep = getattr(args, "keep_checkpoints", 2)
     if "keep_checkpoints" in vars(args) and not args.checkpoint:
         parser.error("--keep-checkpoints requires --checkpoint (it is the "
@@ -264,6 +278,10 @@ def main(argv=None) -> int:
     if args.fsync_checkpoints and not args.checkpoint:
         parser.error("--fsync-checkpoints requires --checkpoint (there is "
                      "nothing to make durable without snapshots)")
+    if args.sync_checkpoints and not args.checkpoint:
+        parser.error("--sync-checkpoints requires --checkpoint (it selects "
+                     "HOW snapshots are written; nothing is saved without "
+                     "one)")
     if keep < 1:
         parser.error(f"--keep-checkpoints must be >= 1, got {keep}")
     if args.retries < 0:
@@ -322,10 +340,21 @@ def main(argv=None) -> int:
         # Written on EVERY exit path — a run that died mid-flight still
         # leaves its partial dispatch/checkpoint data and (when
         # supervised) the per-attempt record: the diagnosis artifacts
-        # matter most exactly when the run gave up.
-        if args.metrics_out:
-            _write_metrics(args, report_holder.get("run_report"))
-        obs_trace.close()
+        # matter most exactly when the run gave up. An artifact-write
+        # failure on that path must not replace the exception being
+        # diagnosed or skip the trace close; on a successful run it
+        # still fails loudly (a requested artifact went missing).
+        in_flight = sys.exc_info()[0] is not None
+        try:
+            if args.metrics_out:
+                _write_metrics(args, report_holder.get("run_report"))
+        except OSError as exc:
+            if not in_flight:
+                raise
+            print(f"metrics: failed to write {args.metrics_out}: {exc}",
+                  file=sys.stderr)
+        finally:
+            obs_trace.close()
 
 
 def _write_metrics(args, run_report: dict | None) -> None:
@@ -350,8 +379,17 @@ def _write_metrics(args, run_report: dict | None) -> None:
 def _print_verbose(result) -> None:
     io = result.extras.get("checkpoint_io")
     if io is not None:
+        # The hidden-vs-blocking split is the async pipeline's whole
+        # point: blocking is what the chunk loop still paid (enqueue +
+        # backpressure + final drain; the full save wall under
+        # --sync-checkpoints), hidden is writer-thread time overlapped
+        # with the next chunk's compute (pull = device→host transfer,
+        # write = container + rename [+ fsync]).
         print(f"checkpoint io: {io['saves']} saves "
-              f"({io['bytes_written']} B, {io['save_s']:.3f}s), "
+              f"({io['bytes_written']} B), "
+              f"blocking {io['save_s']:.3f}s, "
+              f"hidden {io['save_hidden_s']:.3f}s "
+              f"(pull {io['pull_s']:.3f}s, write {io['write_s']:.3f}s), "
               f"{io['loads']} loads "
               f"({io['bytes_read']} B, {io['load_s']:.3f}s)",
               file=sys.stderr)
@@ -372,7 +410,8 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
     if args.checkpoint:
         run_kw = dict(checkpoint_path=args.checkpoint, resume=True,
                       keep_checkpoints=keep,
-                      fsync_checkpoints=args.fsync_checkpoints)
+                      fsync_checkpoints=args.fsync_checkpoints,
+                      sync_checkpoints=args.sync_checkpoints)
     if args.telemetry:
         run_kw["telemetry"] = True
 
@@ -386,6 +425,7 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
                 checkpoint_path=args.checkpoint or None,
                 keep_checkpoints=keep,
                 fsync_checkpoints=args.fsync_checkpoints,
+                sync_checkpoints=args.sync_checkpoints,
                 telemetry=args.telemetry)
         except supervisor.SupervisorError as exc:
             # Park the give-up report for main's finally to dump.
@@ -423,6 +463,14 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
     tel = result.extras.get("telemetry")
     if tel is not None:
         report["telemetry"] = tel["totals"]
+    io = result.extras.get("checkpoint_io")
+    if io is not None:
+        # The hidden/blocking/pull/write split in the machine-readable
+        # report (schema-checked by tools/validate_trace.py
+        # --cli-report), not just the -v stderr line.
+        report["checkpoint_io"] = {
+            k: round(v, 6) if isinstance(v, float) else v
+            for k, v in io.items()}
     rr = result.extras.get("run_report")
     if rr is not None:
         report_holder["run_report"] = rr

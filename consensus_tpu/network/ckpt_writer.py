@@ -1,0 +1,199 @@
+"""Double-buffered async checkpoint writer — snapshot IO off the hot path.
+
+The chunked round loop used to stall on every checkpoint:
+``save_checkpoint`` serialized a device→host pull, an npz write, CRC and
+manifest hashing and (with ``fsync=True``) two fsyncs against the next
+chunk's dispatch, so the device sat idle through the whole save — at
+100k-node carries that is ~GB of IO per snapshot on the hot path
+(``RunResult.extras["checkpoint_io"]``, the ROADMAP's "measure first"
+datum). This module moves the entire save onto ONE background thread
+behind a depth-1 queue, so chunk *k+1* dispatches immediately while
+chunk *k*'s snapshot is pulled and written — the same
+overlap-IO-with-compute discipline the hardware-accelerated consensus
+literature lives by (PAPERS.md).
+
+**Double buffering, precisely.** At most two snapshots are captured at
+once: the one the writer thread is writing and one pending in the
+queue. A third ``submit`` blocks the main loop until the in-flight
+write finishes — that wait is real backpressure (snapshots are being
+produced faster than the disk absorbs them) and is observed in the
+``checkpoint_backpressure_s`` histogram. A deeper queue would retain
+one extra carry of device memory per slot while adding no overlap.
+
+Correctness contracts:
+
+* ``submit`` captures the immutable JAX carry *reference* (jax arrays
+  are never mutated in place) plus ``next_round``/seeds; the
+  device→host transfer runs on the writer thread
+  (``runner._host_arrays``), so the main loop's only cost is the
+  enqueue.
+* The write step is ``runner._write_snapshot`` — the same tmp-file +
+  CRC-manifest + atomic-rename + optional-fsync machinery the sync path
+  uses, so the on-disk bytes are identical to a sync save (asserted
+  per engine in tests/test_ckpt_writer.py) and
+  ``load_checkpoint``/resume/rotation need no changes.
+* Writer-thread errors are never silently dropped: each failure is
+  mirrored into a traced ``checkpoint_write_failed`` event and the
+  ``checkpoint_errors`` counter the moment it happens, then re-raised
+  on the main thread at the next ``submit`` or the final drain barrier.
+* ``drain()`` is the completion barrier: queue empty, in-flight write
+  durably renamed, pending error re-raised. ``runner.run`` drains at
+  run end and on ANY exception (without masking the original failure),
+  so no write is ever in flight when a supervisor retry's resume scans
+  the rotation set — and the crash-injection harness forces the same
+  barrier before ``faults.on_chunk_end()`` so a ``kill_after_chunk``
+  still observes a durably renamed snapshot.
+
+IO accounting (``checkpoint_io``): the main thread owns ``save_s``
+(hot-path blocking: enqueue waits + drain waits); the writer thread
+owns ``saves / save_hidden_s / pull_s / write_s / bytes_written``.
+The two key sets are disjoint, so the shared dict needs no lock.
+"""
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+
+@dataclasses.dataclass
+class _Job:
+    path: Any
+    cfg: Any
+    carry: Any          # immutable JAX pytree reference; pulled off-thread
+    next_round: int
+    seeds: np.ndarray
+    keep: int
+    fsync: bool
+
+
+_SENTINEL = object()
+
+
+class CheckpointWriter:
+    """One background writer thread behind a depth-1 queue.
+
+    ``io`` (optional) is the runner's ``checkpoint_io`` dict; see the
+    module docstring for the key-ownership split that keeps it
+    lock-free.
+    """
+
+    def __init__(self, io: dict | None = None):
+        self._io = io
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._err: BaseException | None = None
+        self._lock = threading.Lock()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="ckpt-writer", daemon=True)
+        self._thread.start()
+
+    # --- main-thread API -----------------------------------------------------
+
+    def submit(self, path, cfg, carry, next_round: int, *, seeds,
+               keep: int = 1, fsync: bool = False) -> float:
+        """Enqueue a snapshot; returns the seconds the enqueue blocked.
+
+        Re-raises any pending writer error BEFORE enqueuing (a failed
+        write must surface within one chunk, not at run end). Blocks
+        when a snapshot is already pending behind the in-flight one —
+        the wait lands in ``checkpoint_backpressure_s`` and in the hot
+        path's ``save_s``.
+        """
+        if self._closed:
+            raise RuntimeError("submit() on a closed CheckpointWriter")
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self._reraise()
+        job = _Job(path, cfg, carry, int(next_round), np.asarray(seeds),
+                   keep, fsync)
+        t0 = time.perf_counter()
+        self._q.put(job)
+        wait = time.perf_counter() - t0
+        obs_metrics.histogram("checkpoint_backpressure_s").observe(wait)
+        if self._io is not None:
+            self._io["save_s"] += wait
+        return wait
+
+    def drain(self) -> None:
+        """Block until every submitted snapshot is durably renamed,
+        then re-raise the first writer error (if any)."""
+        self._q.join()
+        self._reraise()
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Drain remaining jobs, stop the thread, and (by default)
+        re-raise any pending writer error. ``raise_errors=False`` is
+        the exception-path variant: it still WAITS for the in-flight
+        write — a retry's resume must never race a background write to
+        the same rotation set — but lets the caller's original failure
+        propagate (the writer error was already mirrored to the trace
+        and the ``checkpoint_errors`` counter). Idempotent."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(_SENTINEL)
+            self._thread.join()
+        if raise_errors:
+            self._reraise()
+
+    def _reraise(self) -> None:
+        with self._lock:
+            err, self._err = self._err, None
+        if err is not None:
+            raise err
+
+    # --- writer thread -------------------------------------------------------
+
+    def _loop(self) -> None:
+        # Deferred import: runner imports this module at its top level.
+        from . import runner
+        while True:
+            job = self._q.get()
+            try:
+                if job is _SENTINEL:
+                    return
+                self._write(runner, job)
+            except BaseException as exc:  # noqa: BLE001 — mirrored + re-raised
+                obs_metrics.counter("checkpoint_errors").inc()
+                obs_trace.event("checkpoint_write_failed",
+                                next_round=job.next_round, error=repr(exc))
+                with self._lock:
+                    if self._err is None:  # first error wins; later saves
+                        self._err = exc    # may still land fine
+            finally:
+                self._q.task_done()
+                # Drop the job reference BEFORE blocking in get(): the
+                # written snapshot's carry (a full device-memory pytree
+                # — ~GB at flagship scale) must not stay pinned through
+                # the next inter-checkpoint compute window.
+                job = None
+
+    def _write(self, runner, job: _Job) -> None:
+        t0 = time.perf_counter()
+        with obs_trace.span("ckpt_snapshot", next_round=job.next_round) as sp:
+            arrays = runner._host_arrays(job.carry)
+            if sp is not None:
+                sp["bytes"] = int(sum(a.nbytes for a in arrays.values()))
+        t1 = time.perf_counter()
+        with obs_trace.span("ckpt_write", next_round=job.next_round) as sp:
+            nbytes = runner._write_snapshot(job.path, job.cfg, arrays,
+                                            job.next_round, job.seeds,
+                                            job.keep, job.fsync)
+            if sp is not None:
+                sp["bytes"] = nbytes
+        t2 = time.perf_counter()
+        obs_metrics.histogram("checkpoint_hidden_s").observe(t2 - t0)
+        io = self._io
+        if io is not None:
+            io["saves"] += 1
+            io["save_hidden_s"] += t2 - t0
+            io["pull_s"] += t1 - t0
+            io["write_s"] += t2 - t1
+            io["bytes_written"] += nbytes

@@ -14,6 +14,9 @@ modes the resilience tests drive:
     :class:`InjectedTransientError` before the n-th chunk dispatch, to
     exercise the supervisor's retry/resume loop without a real device
     flake;
+  * **kill mid-write** — SIGKILL during the n-th snapshot write (tmp
+    bytes on disk, rename not yet issued), the torn window the async
+    checkpoint writer must recover from via fallback-to-newest-valid;
   * **corrupt / truncate checkpoint bytes** — host-side helpers that
     damage a snapshot the way a torn write or bit-rot would, to prove
     the checksum manifest detects it and recovery falls back to an
@@ -57,30 +60,38 @@ class FaultPlan:
     # supervised retry ("fail dispatch 2, let the retry's dispatches
     # through") needs no re-arming between attempts.
     transient_dispatches: tuple = ()
+    # SIGKILL during the k-th (1-based) snapshot WRITE: after the tmp
+    # file's bytes are on disk, before the atomic rename — the torn
+    # mid-write window. With the async checkpoint pipeline this fires
+    # on the WRITER thread while the main loop may already be
+    # dispatching the next chunk; recovery must come from the newest
+    # previously-renamed rotation (fallback-to-newest-valid).
+    kill_mid_write: int | None = None
 
 
 _PLAN: FaultPlan | None = None
 _ENV_CHECKED = False
 _dispatches = 0
 _chunks = 0
+_writes = 0
 
 
 def install(**kw) -> FaultPlan:
     """Install a fault plan (in-process tests) and zero the counters."""
-    global _PLAN, _ENV_CHECKED, _dispatches, _chunks
+    global _PLAN, _ENV_CHECKED, _dispatches, _chunks, _writes
     kw["transient_dispatches"] = tuple(kw.get("transient_dispatches", ()))
     _PLAN = FaultPlan(**kw)
     _ENV_CHECKED = True
-    _dispatches = _chunks = 0
+    _dispatches = _chunks = _writes = 0
     return _PLAN
 
 
 def reset() -> None:
     """Remove any installed plan and zero the counters."""
-    global _PLAN, _ENV_CHECKED, _dispatches, _chunks
+    global _PLAN, _ENV_CHECKED, _dispatches, _chunks, _writes
     _PLAN = None
     _ENV_CHECKED = True  # an explicit reset also wins over the env
-    _dispatches = _chunks = 0
+    _dispatches = _chunks = _writes = 0
 
 
 def _active() -> FaultPlan | None:
@@ -94,6 +105,14 @@ def _active() -> FaultPlan | None:
                                                     ()))
             _PLAN = FaultPlan(**d)
     return _PLAN
+
+
+def plan_active() -> bool:
+    """Is ANY fault plan installed? The runner uses this to force the
+    async checkpoint writer's drain barrier before :func:`on_chunk_end`,
+    preserving the harness contract that a ``kill_after_chunk`` fires
+    only once that chunk's snapshot is durably renamed."""
+    return _active() is not None
 
 
 def on_dispatch() -> None:
@@ -120,6 +139,23 @@ def on_chunk_end() -> None:
             _chunks == plan.kill_after_chunk:
         print(f"faults: SIGKILL after chunk {_chunks}", file=sys.stderr,
               flush=True)
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def on_checkpoint_write() -> None:
+    """Called by the checkpoint write step (sync save or async writer
+    thread) after the tmp file's bytes are written, BEFORE the rotation
+    renames — the window where a kill leaves a complete-but-invisible
+    tmp and the previous rotation as newest-valid."""
+    global _writes
+    plan = _active()
+    if plan is None:
+        return
+    _writes += 1
+    if plan.kill_mid_write is not None and _writes == plan.kill_mid_write:
+        print(f"faults: SIGKILL mid-write of snapshot {_writes}",
+              file=sys.stderr, flush=True)
         sys.stderr.flush()
         os.kill(os.getpid(), signal.SIGKILL)
 

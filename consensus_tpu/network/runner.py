@@ -43,7 +43,7 @@ from ..core.config import Config
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..parallel import mesh as meshlib
-from . import faults
+from . import ckpt_writer, faults
 
 
 @dataclasses.dataclass(frozen=True)
@@ -239,9 +239,85 @@ def _fsync_dir(path) -> None:
         os.close(fd)
 
 
+def _host_arrays(carry) -> dict:
+    """The snapshot PULL step: the batched carry's leaves as contiguous
+    host arrays under the format's ``leaf_i`` naming. This is where the
+    device→host transfer blocks — the async writer
+    (:mod:`consensus_tpu.network.ckpt_writer`) runs it off-thread so the
+    chunk loop never waits on it."""
+    leaves, _ = jax.tree.flatten(carry)
+    return {f"leaf_{i}": np.ascontiguousarray(x)
+            for i, x in enumerate(leaves)}
+
+
+def _write_npz(path, arrays: dict) -> None:
+    """npz container write with pinned zip timestamps.
+
+    ``np.savez`` stamps each member with the wall-clock mtime, so two
+    saves of identical state differ in bytes across a 2-second DOS-time
+    boundary. Pinning ``date_time`` makes a snapshot's bytes a pure
+    function of (arrays, meta) — which is what lets the async-vs-sync
+    byte-identity contract be TESTED, not just argued. Same container
+    otherwise (STORED members, zip64 allowed); ``np.load`` and the zip
+    member CRCs behave identically.
+    """
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED,
+                         allowZip64=True) as zf:
+        for name, val in arrays.items():
+            info = zipfile.ZipInfo(name + ".npy",
+                                   date_time=(1980, 1, 1, 0, 0, 0))
+            with zf.open(info, "w", force_zip64=True) as fp:
+                np.lib.format.write_array(fp, np.asanyarray(val),
+                                          allow_pickle=False)
+
+
+def _write_snapshot(path, cfg: Config, arrays: dict, next_round: int,
+                    seeds, keep: int, fsync: bool) -> int:
+    """The snapshot WRITE step, shared verbatim by the sync path
+    (:func:`save_checkpoint`) and the async writer: CRC manifest, tmp
+    file, rotation ladder, atomic rename, optional fsync — so the
+    on-disk bytes are identical no matter which thread wrote them.
+    ``arrays`` is :func:`_host_arrays`' dict (already host-resident).
+    Returns the snapshot's byte size."""
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(".tmp.npz")
+    config = json.loads(cfg.to_json())
+    seed_list = [int(s) for s in np.asarray(seeds)]
+    leaf_crc32 = [_leaf_crc(arrays[f"leaf_{i}"])
+                  for i in range(len(arrays))]
+    meta = {"config": config, "next_round": next_round,
+            "seeds": seed_list,
+            "integrity": {
+                "leaf_crc32": leaf_crc32,
+                "manifest_crc32": _manifest_crc(config, next_round,
+                                                seed_list, leaf_crc32)}}
+    _write_npz(tmp, {"__meta__": np.frombuffer(json.dumps(meta).encode(),
+                                               dtype=np.uint8), **arrays})
+    nbytes = tmp.stat().st_size
+    if fsync:
+        _fsync_file(tmp)
+    faults.on_checkpoint_write()  # test seam: SIGKILL mid-write window
+    for i in range(keep - 1, 0, -1):
+        src = rotation_path(path, i - 1)
+        if src.exists():
+            src.replace(rotation_path(path, i))
+    tmp.replace(path)
+    if fsync:
+        _fsync_dir(path.parent)
+    obs_metrics.counter("checkpoint_saves_total").inc()
+    obs_metrics.counter("checkpoint_bytes_written_total").inc(nbytes)
+    return nbytes
+
+
 def save_checkpoint(path, cfg: Config, carry, next_round: int,
                     seeds=None, keep: int = 1, fsync: bool = False) -> dict:
-    """Snapshot the batched carry after ``next_round`` rounds have run.
+    """Snapshot the batched carry after ``next_round`` rounds have run,
+    synchronously on the calling thread (the async pipeline in
+    :mod:`consensus_tpu.network.ckpt_writer` composes the same two steps
+    — :func:`_host_arrays` then :func:`_write_snapshot` — off-thread).
 
     ``seeds`` records the per-sweep seed vector the carry was produced
     with (default: ``make_seeds(cfg)``) so a resume under different
@@ -261,51 +337,25 @@ def save_checkpoint(path, cfg: Config, carry, next_round: int,
     process kill (the common failure) can't produce that state, and on
     network filesystems the sync can dominate the save.
 
-    Returns ``{"bytes": npz_size, "wall_s": duration}`` — the concrete
-    "measure first" numbers the ROADMAP's async-checkpoint item needs
-    (also recorded as metrics and, via the runner, in
+    Returns ``{"bytes", "wall_s", "pull_s", "write_s"}`` — total wall
+    plus the device→host-pull vs container-write split (recorded as
+    metrics and, via the runner, in
     ``RunResult.extras["checkpoint_io"]``).
     """
-    if keep < 1:
-        raise ValueError(f"keep must be >= 1, got {keep}")
     t0 = time.perf_counter()
     with obs_trace.span("checkpoint_save", next_round=next_round) as sp:
-        leaves, _ = jax.tree.flatten(carry)
-        arrays = {f"leaf_{i}": np.ascontiguousarray(x)
-                  for i, x in enumerate(leaves)}
-        path = pathlib.Path(path)
-        path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = path.with_suffix(".tmp.npz")
         seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
-        config = json.loads(cfg.to_json())
-        seed_list = [int(s) for s in seeds]
-        leaf_crc32 = [_leaf_crc(arrays[f"leaf_{i}"])
-                      for i in range(len(leaves))]
-        meta = {"config": config, "next_round": next_round,
-                "seeds": seed_list,
-                "integrity": {
-                    "leaf_crc32": leaf_crc32,
-                    "manifest_crc32": _manifest_crc(config, next_round,
-                                                    seed_list, leaf_crc32)}}
-        np.savez(tmp, __meta__=np.frombuffer(json.dumps(meta).encode(),
-                                             dtype=np.uint8), **arrays)
-        nbytes = tmp.stat().st_size
-        if fsync:
-            _fsync_file(tmp)
-        for i in range(keep - 1, 0, -1):
-            src = rotation_path(path, i - 1)
-            if src.exists():
-                src.replace(rotation_path(path, i))
-        tmp.replace(path)
-        if fsync:
-            _fsync_dir(path.parent)
+        arrays = _host_arrays(carry)
+        t_pull = time.perf_counter()
+        nbytes = _write_snapshot(path, cfg, arrays, next_round, seeds,
+                                 keep, fsync)
+        t_write = time.perf_counter()
         if sp is not None:
             sp["bytes"] = nbytes
     wall = time.perf_counter() - t0
-    obs_metrics.counter("checkpoint_saves_total").inc()
-    obs_metrics.counter("checkpoint_bytes_written_total").inc(nbytes)
     obs_metrics.histogram("checkpoint_save_s").observe(wall)
-    return {"bytes": nbytes, "wall_s": wall}
+    return {"bytes": nbytes, "wall_s": wall, "pull_s": t_pull - t0,
+            "write_s": t_write - t_pull}
 
 
 def _read_verified(path):
@@ -527,22 +577,32 @@ def _prepare(cfg: Config, eng: EngineDef, mesh, seeds=None):
 
 def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
              mesh, checkpoint_path=None, seeds=None, keep: int = 1,
-             telem=None, io: dict | None = None, fsync: bool = False):
+             telem=None, io: dict | None = None, fsync: bool = False,
+             writer=None):
     """Drive fixed-shape jitted chunks from ``start`` to ``cfg.n_rounds``.
     Returns ``(carry, telem)`` — ``telem`` is the accumulated [B, K]
     telemetry counters, or None when telemetry is off.
 
+    With ``writer`` (a :class:`ckpt_writer.CheckpointWriter`) snapshots
+    are ENQUEUED and written in the background while the next chunk
+    dispatches — the hot path pays only the enqueue (plus backpressure
+    when the disk falls a full snapshot behind). Without one, saves run
+    synchronously on this thread (``sync_checkpoints=True``, the
+    pre-async behavior).
+
     The two ``faults`` hooks are the crash-injection harness's seams
     (one ``is None`` check each when no plan is installed): a transient
     error fires BEFORE a chunk dispatches; a kill fires AFTER a chunk
-    completes and its checkpoint (if any) is durably on disk.
+    completes and its checkpoint (if any) is durably on disk — with an
+    async writer the harness forces a drain barrier first, so the
+    kill-after-durable-snapshot contract survives the overlap.
 
     Each chunk dispatch is traced as a "dispatch" span and fed into the
     ``dispatch_wall_s`` histogram. The measured quantity is the HOST
     time inside the dispatch call — on an async backend device work may
-    continue past it; any subsequent checkpoint save (a device→host
-    pull) absorbs the remainder, which is exactly the dispatch-vs-IO
-    split the ROADMAP's async-writer decision needs.
+    continue past it; any subsequent checkpoint pull (a device→host
+    transfer) absorbs the remainder, which with the async writer now
+    happens on the writer thread (the ``ckpt_snapshot`` span).
     """
     r = start
     while r < cfg.n_rounds:
@@ -560,12 +620,27 @@ def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
             time.perf_counter() - t0)
         r += n
         if checkpoint_path and r < cfg.n_rounds:
-            rec = save_checkpoint(checkpoint_path, cfg, carry, r,
-                                  seeds=seeds, keep=keep, fsync=fsync)
+            if writer is not None:
+                writer.submit(checkpoint_path, cfg, carry, r, seeds=seeds,
+                              keep=keep, fsync=fsync)
+            else:
+                rec = save_checkpoint(checkpoint_path, cfg, carry, r,
+                                      seeds=seeds, keep=keep, fsync=fsync)
+                if io is not None:
+                    io["saves"] += 1
+                    io["save_s"] += rec["wall_s"]
+                    io["pull_s"] += rec["pull_s"]
+                    io["write_s"] += rec["write_s"]
+                    io["bytes_written"] += rec["bytes"]
+        if writer is not None and faults.plan_active():
+            # Crash-injection contract (docs/RESILIENCE.md): the kill
+            # hook below must observe this chunk's snapshot durably
+            # renamed, so the harness forces the drain barrier the
+            # production path deliberately skips.
+            t0 = time.perf_counter()
+            writer.drain()
             if io is not None:
-                io["saves"] += 1
-                io["save_s"] += rec["wall_s"]
-                io["bytes_written"] += rec["bytes"]
+                io["save_s"] += time.perf_counter() - t0
         faults.on_chunk_end()
     return carry, telem
 
@@ -609,14 +684,91 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
 
 
 def _empty_io() -> dict:
-    return {"saves": 0, "save_s": 0.0, "bytes_written": 0,
+    # save_s = time the CHUNK LOOP was blocked for checkpointing (the
+    # full save wall when sync; enqueue + backpressure + drain waits
+    # when async). save_hidden_s = writer-thread time overlapped with
+    # compute (0 when sync), split into pull_s (device→host) + write_s
+    # (container + rename [+ fsync]); sync saves fill the same split.
+    return {"saves": 0, "save_s": 0.0, "save_hidden_s": 0.0,
+            "pull_s": 0.0, "write_s": 0.0, "bytes_written": 0,
             "loads": 0, "load_s": 0.0, "bytes_read": 0}
+
+
+# --- grouped-sweep checkpoint layout (groundwork) ----------------------------
+#
+# ROADMAP "supervisor-driven sweep_chunk recovery": a grouped run is a
+# sequence of independent sub-runs, so its resumable layout is one
+# checkpoint SUBDIRECTORY per group (rotations never collide across
+# groups) plus a manifest naming the groups that finished:
+#
+#   root/group_0000/ck.npz (+ rotations)   <- in-progress snapshots
+#   root/group_0001/ck.npz ...
+#   root/groups.json                       <- completed-group manifest
+#
+# run(group_dir=...) writes this layout today; DRIVING a resume from it
+# (skip completed groups, resume the first incomplete one mid-scan) is
+# the supervisor's future PR — which is why checkpoint_path+sweep_chunk
+# stays rejected with a pointer here.
+
+GROUP_MANIFEST_VERSION = 1
+
+
+def group_checkpoint_path(root, group_index: int) -> pathlib.Path:
+    """The snapshot path for group ``group_index`` under ``root``."""
+    return pathlib.Path(root) / f"group_{group_index:04d}" / "ck.npz"
+
+
+def _group_manifest_path(root) -> pathlib.Path:
+    return pathlib.Path(root) / "groups.json"
+
+
+def _seeds_crc(seeds) -> int:
+    return zlib.crc32(np.ascontiguousarray(
+        np.asarray(seeds, dtype=np.uint32)).tobytes())
+
+
+def write_group_manifest(root, cfg: Config, seeds, completed: list,
+                         n_groups: int) -> None:
+    """Atomically record which sweep groups of ``cfg`` have completed.
+    ``seeds`` is the FULL per-sweep seed vector (its CRC guards a future
+    resume against a mislabeled manifest, like snapshot seed vectors)."""
+    root = pathlib.Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    doc = {"version": GROUP_MANIFEST_VERSION,
+           "config": json.loads(cfg.to_json()),
+           "seeds_crc32": _seeds_crc(seeds),
+           "n_groups": int(n_groups),
+           "completed": sorted(int(i) for i in completed)}
+    path = _group_manifest_path(root)
+    tmp = path.with_suffix(".tmp.json")
+    tmp.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    tmp.replace(path)
+
+
+def read_group_manifest(root, cfg: Config, seeds=None):
+    """Completed group indices recorded under ``root`` for (cfg, seeds)
+    — or None when the manifest is missing, unreadable, or belongs to a
+    different run (config or seed-vector mismatch, like
+    :func:`load_checkpoint`'s not-my-snapshot rule)."""
+    try:
+        doc = json.loads(_group_manifest_path(root).read_text())
+    except (OSError, ValueError):
+        return None
+    if doc.get("version") != GROUP_MANIFEST_VERSION:
+        return None
+    if not _meta_matches({"config": doc.get("config", {})}, cfg, None):
+        return None
+    seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
+    if doc.get("seeds_crc32") != _seeds_crc(seeds):
+        return None
+    return sorted(int(i) for i in doc.get("completed", []))
 
 
 def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         resume: bool = False, stats: dict | None = None,
         seeds=None, keep_checkpoints: int = 2,
-        telemetry: bool = False, fsync_checkpoints: bool = False) -> dict:
+        telemetry: bool = False, fsync_checkpoints: bool = False,
+        sync_checkpoints: bool = False, group_dir=None) -> dict:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
@@ -627,6 +779,22 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     docs/RESILIENCE.md). ``fsync_checkpoints=True`` makes each snapshot
     durable against power loss, not just process death (see
     :func:`save_checkpoint`).
+
+    Checkpoints are written ASYNCHRONOUSLY by default: a double-buffered
+    background writer (:mod:`consensus_tpu.network.ckpt_writer`) pulls
+    and writes chunk *k*'s snapshot while chunk *k+1* dispatches, so the
+    chunk loop pays only the enqueue (plus backpressure when the disk
+    falls a full snapshot behind). On-disk bytes, rotation, and resume
+    semantics are identical to a sync save; the pipeline drains at run
+    end and on any exception, re-raising writer errors on this thread.
+    ``sync_checkpoints=True`` restores the on-thread save exactly.
+
+    ``group_dir`` (sweep_chunk grouping only, exclusive with
+    ``checkpoint_path``) writes the grouped-resume LAYOUT groundwork:
+    each group checkpoints into its own subdirectory
+    (:func:`group_checkpoint_path`) and a manifest of completed groups
+    (:func:`write_group_manifest`) is updated as groups finish.
+    Supervisor-driven resume from that layout is a future PR.
 
     If ``stats`` is given it is filled with ``start_round`` and
     ``executed_rounds`` so callers can report throughput for the rounds
@@ -651,28 +819,70 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     if telemetry and stats is None:
         raise ValueError("telemetry=True needs a stats dict to receive "
                          "the counters (stats['telemetry'])")
-    if fsync_checkpoints and not checkpoint_path:
+    if fsync_checkpoints and not (checkpoint_path or group_dir):
         raise ValueError("fsync_checkpoints=True without a checkpoint_path "
                          "would be silently ignored (nothing is saved)")
+    if sync_checkpoints and not (checkpoint_path or group_dir):
+        raise ValueError("sync_checkpoints=True without a checkpoint_path "
+                         "would be silently ignored (nothing is saved)")
+    if group_dir and checkpoint_path:
+        raise ValueError("group_dir and checkpoint_path are exclusive: a "
+                         "grouped run snapshots into per-group "
+                         "subdirectories of group_dir")
+    if group_dir and resume:
+        # Nothing reads the layout back yet (supervisor-driven grouped
+        # resume is a future PR) — dropping the flag silently would
+        # recompute every group from round 0 while the caller believes
+        # completed groups were skipped.
+        raise ValueError("resume is not implemented for group_dir runs "
+                         "yet (the layout + completed-group manifest are "
+                         "groundwork; supervisor-driven grouped resume is "
+                         "a future PR)")
     groups = _sweep_groups(cfg, seeds)
+    if group_dir and groups is None:
+        raise ValueError("group_dir is the grouped-sweep checkpoint layout "
+                         "and needs sweep_chunk grouping; use "
+                         "checkpoint_path for an ungrouped run")
     if groups is not None:
         mesh = _check_groups(cfg, groups, mesh)
         if checkpoint_path:
-            # A grouped run would need one snapshot per group; nothing
-            # writes or resumes that layout, so reject rather than
-            # checkpoint only the last group (no silent ignores).
+            # One rotation set cannot hold N groups' snapshots; reject
+            # rather than checkpoint only the last group (no silent
+            # ignores). The resumable layout exists as groundwork:
+            # run(group_dir=...) writes per-group subdirectories plus a
+            # completed-group manifest (group_checkpoint_path /
+            # write_group_manifest); supervisor-driven resume from it
+            # is a future PR.
             raise ValueError("checkpointing is not supported with "
                              "sweep_chunk; use scan_chunk for mid-run "
-                             "snapshots or sweep_chunk=0")
-        outs, telems = [], []
-        for sub, s in groups:
+                             "snapshots, sweep_chunk=0, or group_dir= for "
+                             "the per-group snapshot layout")
+        all_seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
+        outs, telems, done = [], [], []
+        gio = _empty_io() if group_dir else None
+        for gi, (sub, s) in enumerate(groups):
             gstats: dict = {}
+            kw: dict = {}
+            if group_dir:
+                kw.update(checkpoint_path=group_checkpoint_path(group_dir,
+                                                                gi),
+                          keep_checkpoints=keep_checkpoints,
+                          fsync_checkpoints=fsync_checkpoints,
+                          sync_checkpoints=sync_checkpoints)
             outs.append(run(sub, eng, mesh=mesh, stats=gstats, seeds=s,
-                            telemetry=telemetry))
+                            telemetry=telemetry, **kw))
+            if group_dir:
+                done.append(gi)
+                write_group_manifest(group_dir, cfg, all_seeds, done,
+                                     len(groups))
+                for k, v in gstats.pop("checkpoint_io").items():
+                    gio[k] += v
             if telemetry:
                 telems.append(gstats.pop("telemetry"))
             if stats is not None:
                 stats.update(gstats)
+        if group_dir and stats is not None:
+            stats["checkpoint_io"] = gio
         if telemetry:
             stats["telemetry"] = {
                 k: np.concatenate([t[k] for t in telems])
@@ -713,10 +923,29 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         stats["start_round"] = start
     telem = (jnp.zeros((cfg.n_sweeps, len(eng.telemetry_names)), jnp.int32)
              if telemetry else None)
-    carry, telem = _advance(cfg, eng, carry, start, chunk, mesh,
-                            checkpoint_path, seeds=np.asarray(seeds),
-                            keep=keep_checkpoints, telem=telem, io=io,
-                            fsync=fsync_checkpoints)
+    writer = (ckpt_writer.CheckpointWriter(io=io)
+              if checkpoint_path and not sync_checkpoints else None)
+    try:
+        carry, telem = _advance(cfg, eng, carry, start, chunk, mesh,
+                                checkpoint_path, seeds=np.asarray(seeds),
+                                keep=keep_checkpoints, telem=telem, io=io,
+                                fsync=fsync_checkpoints, writer=writer)
+    except BaseException:
+        if writer is not None:
+            # Wait for the in-flight write (a supervisor retry's resume
+            # must never race a background write to the same rotation
+            # set) but let the ORIGINAL failure propagate — any writer
+            # error was already mirrored to the trace and the
+            # checkpoint_errors counter.
+            writer.close(raise_errors=False)
+        raise
+    if writer is not None:
+        # Final drain barrier: every snapshot durably renamed, pending
+        # writer errors re-raised here. The wait is hot-path blocking
+        # time — the one place the pipeline can't hide behind compute.
+        t0 = time.perf_counter()
+        writer.close()
+        io["save_s"] += time.perf_counter() - t0
 
     if stats is not None:
         stats["executed_rounds"] = cfg.n_rounds - start

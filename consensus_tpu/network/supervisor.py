@@ -107,6 +107,7 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                    jitter_rng=None, deadline_s: float | None = None,
                    fallback_cpu: bool = False, checkpoint_path=None,
                    keep_checkpoints: int = 2, fsync_checkpoints: bool = False,
+                   sync_checkpoints: bool = False,
                    mesh=None, seeds=None,
                    warmup: bool = False, telemetry: bool = False,
                    sleep=time.sleep):
@@ -123,7 +124,18 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     blip must not stampede the device in lockstep); pass a seeded
     ``jitter_rng`` (``random.Random``) for deterministic delays in
     tests, or ``backoff_jitter=0`` to disable. ``fsync_checkpoints``
-    passes through to the checkpoint writer (docs/RESILIENCE.md §2b).
+    passes through to the checkpoint writer (docs/RESILIENCE.md §2b),
+    as does ``sync_checkpoints`` (write snapshots on the chunk loop
+    instead of the default async double-buffered pipeline).
+
+    Retry/deadline vs the async checkpoint pipeline: the runner drains
+    its background writer before ANY exception propagates out of an
+    attempt, so by the time a failure is classified here no write is in
+    flight — the next attempt's resume scans a quiescent rotation set,
+    and the "flake costs one chunk" accounting still holds (the
+    interrupted attempt's last submitted snapshot is durably renamed
+    during that drain). A deadline never interrupts a running attempt,
+    so it never interrupts an in-flight write either.
     ``deadline_s`` is a wall-clock budget: no new attempt (or backoff
     sleep) starts past it. When everything is exhausted,
     ``fallback_cpu=True`` reruns the config on the CPU oracle engine —
@@ -194,7 +206,8 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
             if checkpoint_path:
                 kw.update(checkpoint_path=checkpoint_path, resume=True,
                           keep_checkpoints=keep_checkpoints,
-                          fsync_checkpoints=fsync_checkpoints)
+                          fsync_checkpoints=fsync_checkpoints,
+                          sync_checkpoints=sync_checkpoints)
             if mesh is not None:
                 kw["mesh"] = mesh
             if seeds is not None:

@@ -1,0 +1,405 @@
+"""Async double-buffered checkpoint writer (network/ckpt_writer.py).
+
+Contracts under test:
+
+1. **Bit-identity** — async (the default) vs sync checkpointing produce
+   the same final state for every engine AND byte-identical snapshot
+   files at every rotation, including runs with a ragged tail chunk
+   (snapshot bytes are a pure function of carry + meta since the zip
+   timestamps were pinned, so equality is exact, not modulo mtime).
+2. **Overlap accounting** — with the write step artificially slowed and
+   the chunk compute slowed slightly more, the chunk loop's blocking
+   time (``save_s``) stays strictly below the sync baseline's while
+   ``save_hidden_s`` records the overlapped work. The injected delays
+   dominate scheduler noise, so the ordering is deterministic — no
+   wall-clock-flaky thresholds.
+3. **Backpressure** — when writes are slower than two chunks of
+   compute, the depth-1 queue blocks the third submit and the wait
+   lands in the ``checkpoint_backpressure_s`` histogram.
+4. **Error mirroring** — a writer-thread failure is recorded as a
+   traced ``checkpoint_write_failed`` event plus the
+   ``checkpoint_errors`` counter, then re-raised on the main thread at
+   the next submit or the final drain barrier (never silently dropped).
+5. **Crash-injection contract** — with a fault plan active,
+   ``faults.on_chunk_end`` observes each chunk's snapshot durably
+   renamed (the harness forces the drain barrier), so kill-after-chunk
+   semantics survive the overlap.
+6. **Grouped-sweep groundwork** — ``run(group_dir=...)`` writes the
+   per-group subdirectory layout plus a completed-group manifest that
+   round-trips (and rejects foreign configs/seeds).
+"""
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.network import faults, runner, simulator
+from consensus_tpu.obs import metrics as obs_metrics
+from consensus_tpu.obs import trace as obs_trace
+
+ADV = dict(drop_rate=0.1, partition_rate=0.05, churn_rate=0.05)
+
+# scan_chunk=7 over 24 rounds → chunks 7+7+7+3: saves at r=7,14,21 and a
+# ragged TAIL chunk after the last save (the acceptance criterion's
+# "incl. scan_chunk tail chunks").
+ENGINE_CFGS = {
+    "raft": Config(protocol="raft", n_nodes=5, n_rounds=24, n_sweeps=2,
+                   log_capacity=16, max_entries=8, scan_chunk=7, **ADV),
+    "raft-sparse": Config(protocol="raft", n_nodes=16, max_active=4,
+                          n_rounds=24, n_sweeps=2, log_capacity=16,
+                          max_entries=8, scan_chunk=7, **ADV),
+    "pbft": Config(protocol="pbft", f=1, n_nodes=4, n_rounds=24,
+                   log_capacity=8, scan_chunk=7, **ADV),
+    "pbft-bcast": Config(protocol="pbft", fault_model="bcast", f=2,
+                         n_nodes=7, n_rounds=24, log_capacity=8,
+                         scan_chunk=7, **ADV),
+    "paxos": Config(protocol="paxos", n_nodes=7, n_rounds=24,
+                    log_capacity=8, scan_chunk=7, **ADV),
+    "dpos": Config(protocol="dpos", n_nodes=16, n_rounds=24,
+                   log_capacity=32, n_candidates=8, n_producers=2,
+                   epoch_len=8, scan_chunk=7, **ADV),
+}
+
+CFG = dataclasses.replace(ENGINE_CFGS["raft"], n_rounds=48, scan_chunk=8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --- 1. async-vs-sync bit-identity -------------------------------------------
+
+@pytest.mark.parametrize("name", list(ENGINE_CFGS))
+def test_async_equals_sync_bit_identical_per_engine(name, tmp_path):
+    cfg = ENGINE_CFGS[name]
+    eng = simulator.engine_def(cfg)
+    ck_s, ck_a = tmp_path / "sync" / "ck.npz", tmp_path / "async" / "ck.npz"
+    s_stats, a_stats = {}, {}
+    out_s = runner.run(cfg, eng, checkpoint_path=ck_s, keep_checkpoints=4,
+                       sync_checkpoints=True, stats=s_stats)
+    out_a = runner.run(cfg, eng, checkpoint_path=ck_a, keep_checkpoints=4,
+                       stats=a_stats)
+    for k in out_s:
+        np.testing.assert_array_equal(out_s[k], out_a[k], err_msg=k)
+
+    # On-disk snapshot bytes: every rotation byte-identical. keep=4 and
+    # 3 saves (r=7,14,21), so nothing rotated away.
+    cands_s = runner.checkpoint_candidates(ck_s)
+    cands_a = runner.checkpoint_candidates(ck_a)
+    assert [p.name for p in cands_s] == [p.name for p in cands_a]
+    assert len(cands_s) == 3
+    for ps, pa in zip(cands_s, cands_a):
+        assert ps.read_bytes() == pa.read_bytes(), (ps, pa)
+
+    # Accounting shape: async hid work off-thread, sync hid none.
+    aio, sio = a_stats["checkpoint_io"], s_stats["checkpoint_io"]
+    assert aio["saves"] == sio["saves"] == 3
+    assert aio["bytes_written"] == sio["bytes_written"]
+    assert aio["save_hidden_s"] > 0 and aio["pull_s"] > 0 \
+        and aio["write_s"] > 0
+    assert sio["save_hidden_s"] == 0.0
+    assert sio["pull_s"] > 0 and sio["write_s"] > 0
+
+
+def test_async_digest_and_resume_bit_identical(tmp_path):
+    """Final digest through the simulator front door, plus a resume
+    from an async-written snapshot — the format really is unchanged."""
+    base = simulator.run(CFG, warmup=False)
+    ck = tmp_path / "ck.npz"
+    res = simulator.run(CFG, warmup=False, checkpoint_path=str(ck),
+                        resume=True)
+    assert res.digest == base.digest
+    assert res.extras["checkpoint_io"]["save_hidden_s"] > 0
+    resumed = simulator.run(CFG, warmup=False, checkpoint_path=str(ck),
+                            resume=True)
+    assert resumed.digest == base.digest
+    assert resumed.extras["checkpoint_io"]["loads"] == 1
+
+
+def test_snapshot_bytes_deterministic_across_time(tmp_path):
+    """The pinned-timestamp container: snapshot bytes are a pure
+    function of carry + meta — the property the async-vs-sync byte
+    comparison stands on. Asserted structurally (every zip member
+    carries the pinned epoch, not the wall clock) plus byte equality of
+    two saves, so no sleep across a 2-second DOS-mtime boundary is
+    needed to prove it."""
+    import zipfile
+
+    import jax.numpy as jnp
+    from consensus_tpu.engines import raft
+    eng = raft.get_engine()
+    seeds = jnp.asarray(runner.make_seeds(CFG))
+    carry = runner._chunk_jit(CFG, eng, 8,
+                              runner._init_jit(CFG, eng, seeds),
+                              jnp.int32(0))
+    a, b = tmp_path / "a.npz", tmp_path / "b.npz"
+    runner.save_checkpoint(a, CFG, carry, 8)
+    runner.save_checkpoint(b, CFG, carry, 8)
+    assert a.read_bytes() == b.read_bytes()
+    with zipfile.ZipFile(a) as zf:
+        assert zf.namelist()[0] == "__meta__.npy"  # member order kept
+        for info in zf.infolist():
+            assert info.date_time == (1980, 1, 1, 0, 0, 0), info.filename
+
+
+# --- 2. overlap: blocking strictly below the sync baseline -------------------
+
+def _slowed(monkeypatch, write_delay, compute_delay):
+    real_write = runner._write_snapshot
+    real_chunk = runner._chunk_jit
+
+    def slow_write(*a, **kw):
+        time.sleep(write_delay)
+        return real_write(*a, **kw)
+
+    def slow_chunk(*a, **kw):
+        time.sleep(compute_delay)
+        return real_chunk(*a, **kw)
+
+    monkeypatch.setattr(runner, "_write_snapshot", slow_write)
+    monkeypatch.setattr(runner, "_chunk_jit", slow_chunk)
+
+
+def test_async_blocking_strictly_below_sync_baseline(tmp_path, monkeypatch):
+    """THE acceptance criterion: with the write step slowed by 25 ms and
+    each chunk's compute slowed by 30 ms, the sync baseline must block
+    the chunk loop >= 5 x 25 ms while the async pipeline hides every
+    write behind the next chunk (blocking ~= enqueue epsilons). The
+    injected delays make the ordering deterministic — this asserts
+    async < sync, not any absolute wall-clock number."""
+    eng = simulator.engine_def(CFG)
+    base = runner.run(CFG, eng)  # compile before the slowdown
+    _slowed(monkeypatch, write_delay=0.025, compute_delay=0.030)
+
+    s_stats, a_stats = {}, {}
+    out_s = runner.run(CFG, eng, checkpoint_path=tmp_path / "s.npz",
+                       sync_checkpoints=True, stats=s_stats)
+    out_a = runner.run(CFG, eng, checkpoint_path=tmp_path / "a.npz",
+                       stats=a_stats)
+    for k in base:
+        np.testing.assert_array_equal(base[k], out_a[k], err_msg=k)
+        np.testing.assert_array_equal(base[k], out_s[k], err_msg=k)
+
+    sio, aio = s_stats["checkpoint_io"], a_stats["checkpoint_io"]
+    assert sio["saves"] == aio["saves"] == 5  # 48 rounds / chunk 8
+    assert sio["save_s"] >= 5 * 0.025          # sync pays every write
+    assert aio["save_s"] < sio["save_s"]       # async strictly below
+    assert aio["save_hidden_s"] >= 5 * 0.025   # ...because it hid them
+
+
+def test_backpressure_blocks_and_is_observed(tmp_path, monkeypatch):
+    """Depth-1 queue semantics: writes slower than two chunks of compute
+    force the third submit to wait for the in-flight write; the wait is
+    observed in checkpoint_backpressure_s and counted as blocking."""
+    eng = simulator.engine_def(CFG)
+    runner.run(CFG, eng)  # compile before the slowdown
+    _slowed(monkeypatch, write_delay=0.05, compute_delay=0.0)
+    obs_metrics.reset()
+    stats: dict = {}
+    runner.run(CFG, eng, checkpoint_path=tmp_path / "ck.npz", stats=stats)
+    h = obs_metrics.snapshot()["checkpoint_backpressure_s"]
+    assert h["count"] == 5                      # one observation per submit
+    # With ~0 compute the pipeline degenerates to sequential writes:
+    # at least the 3rd..5th submits must have genuinely blocked.
+    assert h["sum"] >= 3 * 0.04
+    assert stats["checkpoint_io"]["save_s"] >= 3 * 0.04
+
+
+# --- 3. writer errors are mirrored, then re-raised ---------------------------
+
+@pytest.mark.parametrize("n_rounds, surface", [(48, "next submit"),
+                                               (16, "final drain")])
+def test_writer_error_mirrored_and_reraised(tmp_path, monkeypatch, capsys,
+                                            n_rounds, surface):
+    cfg = dataclasses.replace(CFG, n_rounds=n_rounds)
+    eng = simulator.engine_def(cfg)
+
+    def boom(*a, **kw):
+        raise OSError("disk full (injected)")
+
+    monkeypatch.setattr(runner, "_write_snapshot", boom)
+    obs_metrics.reset()
+    trace_path = tmp_path / "t.jsonl"
+    obs_trace.configure(str(trace_path))
+    try:
+        with pytest.raises(OSError, match="disk full"):
+            # 48 rounds: error surfaces at the SECOND submit; 16 rounds
+            # (single save): only the final drain barrier can raise it.
+            runner.run(cfg, eng, checkpoint_path=tmp_path / "ck.npz")
+    finally:
+        obs_trace.close()
+    assert obs_metrics.snapshot()["checkpoint_errors"]["value"] >= 1, surface
+    recs = [json.loads(x) for x in trace_path.read_text().splitlines()[1:]]
+    evs = [r for r in recs if r["type"] == "event"
+           and r["name"] == "checkpoint_write_failed"]
+    assert evs and "disk full" in evs[0]["attrs"]["error"]
+    assert evs[0]["attrs"]["next_round"] == 8
+
+
+def test_exception_in_chunk_loop_still_drains_writer(tmp_path, monkeypatch):
+    """A main-loop failure must wait for the in-flight write (no
+    background write may race a retry's resume) and must propagate the
+    ORIGINAL error, not a writer state error."""
+    eng = simulator.engine_def(CFG)
+    runner.run(CFG, eng)  # compile first
+    monkeypatch.setattr(runner, "_write_snapshot",
+                        _delayed(runner._write_snapshot, 0.05))
+    faults.install(transient_dispatches=[3])
+    ck = tmp_path / "ck.npz"
+    with pytest.raises(faults.InjectedTransientError):
+        runner.run(CFG, eng, checkpoint_path=ck)
+    # Both completed chunks' snapshots are durably renamed post-drain.
+    assert runner.peek_checkpoint(ck, CFG) == 16
+
+
+def _delayed(fn, delay):
+    def wrapper(*a, **kw):
+        time.sleep(delay)
+        return fn(*a, **kw)
+    return wrapper
+
+
+# --- 4. crash-injection contract under the async writer ----------------------
+
+def test_kill_hook_observes_durable_snapshot(tmp_path, monkeypatch):
+    """With a fault plan active, by the time on_chunk_end fires the
+    just-submitted snapshot is durably renamed (the harness forces the
+    drain barrier) — kill_after_chunk keeps its pre-async meaning."""
+    ck = tmp_path / "ck.npz"
+    faults.install(kill_after_chunk=9999)  # plan active; kill never fires
+    seen = []
+    orig = faults.on_chunk_end
+
+    def probe():
+        seen.append(runner.peek_checkpoint(ck, CFG))
+        orig()
+
+    monkeypatch.setattr(faults, "on_chunk_end", probe)
+    eng = simulator.engine_def(CFG)
+    runner.run(CFG, eng, checkpoint_path=ck)
+    # Saves at r=8..40; the final chunk (40→48) saves nothing, so the
+    # last hook still sees 40.
+    assert seen == [8, 16, 24, 32, 40, 40]
+
+
+# --- 5. usage errors ---------------------------------------------------------
+
+def test_sync_checkpoints_without_path_rejected():
+    eng = simulator.engine_def(CFG)
+    with pytest.raises(ValueError, match="sync_checkpoints"):
+        runner.run(CFG, eng, sync_checkpoints=True)
+
+
+def test_submit_after_close_rejected():
+    from consensus_tpu.network.ckpt_writer import CheckpointWriter
+    w = CheckpointWriter()
+    w.close()
+    w.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit("x.npz", CFG, None, 8, seeds=np.zeros(2, np.uint32))
+
+
+# --- 6. grouped-sweep resume groundwork --------------------------------------
+
+GCFG = dataclasses.replace(ENGINE_CFGS["raft"], n_sweeps=4, sweep_chunk=3,
+                           scan_chunk=8)
+
+
+def test_group_dir_layout_manifest_and_bit_identity(tmp_path):
+    eng = simulator.engine_def(GCFG)
+    base = runner.run(dataclasses.replace(GCFG, sweep_chunk=0), eng)
+    root = tmp_path / "groups"
+    stats: dict = {}
+    out = runner.run(GCFG, eng, group_dir=root, stats=stats)
+    for k in base:
+        np.testing.assert_array_equal(base[k], out[k], err_msg=k)
+    # Layout: one subdirectory per group (4 sweeps / chunk 3 → 2 groups),
+    # each holding its own rotation set, plus the manifest.
+    assert runner.group_checkpoint_path(root, 0).exists()
+    assert runner.group_checkpoint_path(root, 1).exists()
+    assert runner.read_group_manifest(root, GCFG) == [0, 1]
+    # Aggregated IO across groups: each group saved at r=8, 16.
+    assert stats["checkpoint_io"]["saves"] == 4
+    # Foreign config or seed vector → not-my-manifest, like snapshots.
+    assert runner.read_group_manifest(
+        root, dataclasses.replace(GCFG, seed=GCFG.seed + 1)) is None
+    assert runner.read_group_manifest(
+        root, GCFG, seeds=np.asarray([7, 8, 9, 10], np.uint32)) is None
+    # Each group's snapshots validate for ITS sub-config and seed slice.
+    groups = runner._sweep_groups(GCFG)
+    for gi, (sub, s) in enumerate(groups):
+        assert runner.peek_checkpoint(
+            runner.group_checkpoint_path(root, gi), sub, seeds=s) == 16
+
+
+def test_group_dir_usage_errors(tmp_path):
+    eng = simulator.engine_def(GCFG)
+    with pytest.raises(ValueError, match="exclusive"):
+        runner.run(GCFG, eng, group_dir=tmp_path / "g",
+                   checkpoint_path=tmp_path / "ck.npz")
+    with pytest.raises(ValueError, match="sweep_chunk"):
+        runner.run(dataclasses.replace(GCFG, sweep_chunk=0), eng,
+                   group_dir=tmp_path / "g")
+    # resume is not implemented for the grouped layout yet — dropping
+    # the flag silently would recompute every group while the caller
+    # believes completed ones were skipped (no silent ignores).
+    with pytest.raises(ValueError, match="resume"):
+        runner.run(GCFG, eng, group_dir=tmp_path / "g", resume=True)
+
+
+def test_checkpoint_with_sweep_chunk_points_to_group_dir(tmp_path):
+    eng = simulator.engine_def(GCFG)
+    with pytest.raises(ValueError, match="group_dir"):
+        runner.run(GCFG, eng, checkpoint_path=tmp_path / "ck.npz")
+
+
+# --- CLI integration ---------------------------------------------------------
+
+def _cli_flags(ck=None, extra=()):
+    from consensus_tpu import cli
+    flags = ["--protocol", "raft", "--nodes", "5", "--rounds", "48",
+             "--sweeps", "2", "--log-capacity", "16", "--max-entries", "8",
+             "--scan-chunk", "8", "--drop-rate", "0.1",
+             "--partition-rate", "0.05", "--churn-rate", "0.05",
+             "--engine", "tpu", "--platform", "cpu"]
+    if ck is not None:
+        flags += ["--checkpoint", str(ck)]
+    return cli, flags + list(extra)
+
+
+def test_cli_sync_checkpoints_roundtrip_and_verbose(tmp_path, capsys):
+    base = simulator.run(CFG, warmup=False)
+    cli, flags = _cli_flags(tmp_path / "a.npz", ["-v"])
+    assert cli.main(flags) == 0
+    cap = capsys.readouterr()
+    rep_async = json.loads(cap.out.strip().splitlines()[-1])
+    assert rep_async["digest"] == base.digest
+    assert "hidden" in cap.err and "blocking" in cap.err
+    io = rep_async["checkpoint_io"]
+    assert io["saves"] == 5 and io["save_hidden_s"] > 0
+
+    cli2, flags2 = _cli_flags(tmp_path / "s.npz",
+                              ["--sync-checkpoints", "-v"])
+    assert cli2.main(flags2) == 0
+    rep_sync = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep_sync["digest"] == base.digest
+    assert rep_sync["checkpoint_io"]["save_hidden_s"] == 0
+
+
+def test_cli_sync_checkpoints_requires_checkpoint():
+    cli, flags = _cli_flags(extra=["--sync-checkpoints"])
+    with pytest.raises(SystemExit):
+        cli.main(flags)
+
+
+def test_cli_rejects_sync_checkpoints_on_cpu_engine():
+    from consensus_tpu import cli
+    with pytest.raises(SystemExit):
+        cli.main(["--protocol", "raft", "--engine", "cpu",
+                  "--sync-checkpoints"])

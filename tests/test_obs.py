@@ -366,6 +366,81 @@ def test_validator_flags_unknown_telemetry_counter(tmp_path):
     assert any("crashes" in e and ">= 0" in e for e in errs)
 
 
+def test_cli_async_checkpoint_artifacts_validate(tmp_path, capsys):
+    """A fresh async-checkpointing CLI run's artifacts pass the
+    validator — including the writer spans asserted present via
+    --expect-spans and the extended checkpoint_io block in the CLI
+    report (exactly as CI would run it: subprocess, nonzero on drift)."""
+    from consensus_tpu import cli
+    trace = tmp_path / "run.trace.jsonl"
+    metrics = tmp_path / "metrics.json"
+    rc = cli.main(["--protocol", "raft", "--nodes", "5", "--rounds", "32",
+                   "--sweeps", "2", "--log-capacity", "16",
+                   "--max-entries", "8", "--drop-rate", "0.1",
+                   "--engine", "tpu", "--scan-chunk", "8",
+                   "--checkpoint", str(tmp_path / "ck.npz"),
+                   "--trace-out", str(trace),
+                   "--metrics-out", str(metrics)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    io = report["checkpoint_io"]
+    assert io["saves"] == 3 and io["save_hidden_s"] > 0
+    cli_report = tmp_path / "report.json"
+    cli_report.write_text(json.dumps(report))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "validate_trace.py"),
+         "--trace", str(trace), "--metrics", str(metrics),
+         "--cli-report", str(cli_report),
+         "--expect-spans", "ckpt_snapshot,ckpt_write"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    # The metrics snapshot carries the new writer instruments.
+    doc = json.loads(metrics.read_text())
+    assert doc["metrics"]["checkpoint_hidden_s"]["count"] >= 3
+    assert doc["metrics"]["checkpoint_backpressure_s"]["count"] >= 3
+
+    v = _load_validator()
+    # Field drift in checkpoint_io trips the registry — both ways.
+    bad = dict(report)
+    bad["checkpoint_io"] = {**io, "weird_s": 1.0}
+    b = tmp_path / "bad.json"
+    b.write_text(json.dumps(bad))
+    assert any("weird_s" in e for e in v.validate_cli_report(b))
+    bad["checkpoint_io"] = {k: x for k, x in io.items() if k != "pull_s"}
+    b.write_text(json.dumps(bad))
+    assert any("pull_s" in e for e in v.validate_cli_report(b))
+    # --expect-spans fails when the writer spans are absent (e.g. the
+    # trace of a --sync-checkpoints run).
+    assert v.validate_expected_spans(trace, ["ckpt_snapshot"]) == []
+    assert v.validate_expected_spans(trace, ["nonsense_span"])
+    # --expect-events: registered-name + presence checks both bite
+    # (nothing failed in this run, so the error event is rightly absent).
+    assert v.validate_expected_events(trace, ["nonsense_ev"])
+    assert v.validate_expected_events(trace, ["checkpoint_write_failed"])
+
+
+def test_cli_sync_checkpoint_trace_has_no_writer_spans(tmp_path, capsys):
+    """--sync-checkpoints restores the pre-async trace shape: saves
+    appear as checkpoint_save spans on the hot path and --expect-spans
+    for the writer spans correctly fails."""
+    from consensus_tpu import cli
+    trace = tmp_path / "t.jsonl"
+    rc = cli.main(["--protocol", "raft", "--nodes", "5", "--rounds", "32",
+                   "--log-capacity", "16", "--max-entries", "8",
+                   "--engine", "tpu", "--scan-chunk", "8",
+                   "--checkpoint", str(tmp_path / "ck.npz"),
+                   "--sync-checkpoints", "--trace-out", str(trace)])
+    assert rc == 0
+    capsys.readouterr()
+    names = [json.loads(x).get("name")
+             for x in trace.read_text().splitlines()[1:]]
+    assert names.count("checkpoint_save") == 3
+    assert "ckpt_snapshot" not in names and "ckpt_write" not in names
+    v = _load_validator()
+    errs = v.validate_expected_spans(trace, ["ckpt_snapshot", "ckpt_write"])
+    assert len(errs) == 2
+
+
 def test_cli_artifacts_exclude_warmup(tmp_path, capsys):
     """The hidden warmup pass (compile) must not pollute exported
     artifacts: dispatch_wall_s counts exactly the timed run's chunks,
@@ -394,12 +469,14 @@ def test_cli_failed_supervised_run_still_writes_artifacts(tmp_path, capsys):
     from consensus_tpu import cli
     from consensus_tpu.network import faults, supervisor
     metrics = tmp_path / "m.json"
+    trace = tmp_path / "t.jsonl"
     faults.install(transient_dispatches=(1, 2))
     try:
         with pytest.raises(supervisor.SupervisorError):
             cli.main(["--protocol", "raft", "--nodes", "5", "--rounds", "8",
                       "--log-capacity", "8", "--max-entries", "4",
                       "--engine", "tpu", "--retries", "1",
+                      "--trace-out", str(trace),
                       "--metrics-out", str(metrics)])
     finally:
         faults.reset()
@@ -408,6 +485,10 @@ def test_cli_failed_supervised_run_still_writes_artifacts(tmp_path, capsys):
     assert metrics.exists() and report.exists()
     assert _load_validator().validate_metrics(metrics) == []
     assert _load_validator().validate_report(report) == []
+    # The retry record is in the trace too — the --expect-events
+    # registry's positive case.
+    assert _load_validator().validate_expected_events(
+        trace, ["attempt_failed", "backoff"]) == []
     doc = json.loads(report.read_text())
     assert doc["n_attempts"] == 2
     assert all(a["error"] for a in doc["attempts"])
@@ -430,6 +511,28 @@ def test_cli_failed_unsupervised_run_still_writes_metrics(tmp_path, capsys):
     capsys.readouterr()
     assert metrics.exists()
     assert _load_validator().validate_metrics(metrics) == []
+
+
+def test_cli_metrics_write_failure_does_not_mask_run_error(tmp_path, capsys):
+    """An artifact-write failure in main's finally must not replace the
+    in-flight exception (the one being diagnosed) — but on a successful
+    run a missing artifact still fails loudly."""
+    from consensus_tpu import cli
+    from consensus_tpu.network import faults
+    gone = tmp_path / "removed-dir" / "m.json"  # parent doesn't exist
+    flags = ["--protocol", "raft", "--nodes", "5", "--rounds", "8",
+             "--log-capacity", "8", "--max-entries", "4",
+             "--engine", "tpu", "--metrics-out", str(gone)]
+    faults.install(transient_dispatches=(1,))
+    try:
+        with pytest.raises(faults.InjectedTransientError):
+            cli.main(flags)  # the run's error wins; write failure -> stderr
+    finally:
+        faults.reset()
+    assert "failed to write" in capsys.readouterr().err
+    with pytest.raises(OSError):
+        cli.main(flags)  # successful run, artifact missing -> loud
+    capsys.readouterr()
 
 
 def test_cli_prometheus_metrics_out(tmp_path, capsys):

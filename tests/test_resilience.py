@@ -499,6 +499,29 @@ def test_sigkill_midrun_with_crash_adversary_is_bit_identical(tmp_path):
 
 
 @pytest.mark.slow
+def test_sigkill_mid_async_write_recovers_newest_valid(tmp_path):
+    """Acceptance: a real SIGKILL DURING an in-flight async snapshot
+    write — tmp bytes on disk, atomic rename not yet issued, fired on
+    the WRITER thread while the chunk loop is already past the submit —
+    is recovered by fallback-to-newest-valid: the torn write never
+    becomes visible, the previous rotation resumes, and the digest is
+    bit-identical to an uninterrupted run."""
+    ck = tmp_path / "ck.npz"
+    p = _spawn_cli(ck, fault_plan={"kill_mid_write": 2},
+                   extra=["--keep-checkpoints", "3"])
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr)
+    # Write 2 (round 16) died pre-rename: its tmp is orphaned on disk
+    # and the newest VALID snapshot is write 1 (round 8).
+    assert (tmp_path / "ck.tmp.npz").exists()
+    assert runner.peek_checkpoint(ck, CFG) == 8
+
+    base = simulator.run(CFG, warmup=False)
+    res = supervisor.supervised_run(CFG, checkpoint_path=ck, retries=0)
+    assert res.digest == base.digest
+    assert res.extras["run_report"]["resumed_from_round"] == 8
+
+
+@pytest.mark.slow
 def test_cli_retries_transient_fault_end_to_end(tmp_path):
     """A child `python -m consensus_tpu --retries 2` hit by an injected
     transient error on dispatch 3 must retry, resume from round 16, and

@@ -43,6 +43,33 @@ TELEMETRY_COUNTERS = frozenset({
     "crashes", "recoveries", "nodes_down",
 })
 
+# Every span/event name a framework emitter may write (the
+# docs/OBSERVABILITY.md span inventory). Traces may also carry
+# caller-defined names (validate_trace stays name-agnostic for them);
+# --expect-spans asserts specific REGISTERED spans actually appear —
+# the async-checkpointing tripwire (`ckpt_snapshot`/`ckpt_write` are
+# the background writer's pull/write stages).
+SPAN_NAMES = frozenset({
+    "dispatch", "checkpoint_save", "checkpoint_load",
+    "ckpt_snapshot", "ckpt_write",
+    "warmup", "supervised_attempt", "oracle_fallback", "oracle_run",
+    "pbft_fsweep",
+})
+EVENT_NAMES = frozenset({
+    "attempt_failed", "backoff", "checkpoint_write_failed",
+})
+
+# The CLI report's `checkpoint_io` block (async checkpoint pipeline):
+# counts/bytes plus the blocking-vs-hidden wall split. Exactly these
+# keys — a missing OR unknown key means the runner's accounting and
+# this tripwire have drifted.
+CHECKPOINT_IO_FIELDS = frozenset({
+    "saves", "save_s", "save_hidden_s", "pull_s", "write_s",
+    "bytes_written", "loads", "load_s", "bytes_read",
+})
+_CHECKPOINT_IO_INTS = frozenset({"saves", "loads", "bytes_written",
+                                 "bytes_read"})
+
 _SCALAR = (bool, int, float, str, type(None))
 
 
@@ -115,6 +142,48 @@ def validate_trace(path) -> list:
                     errs.append(f"{path}:{i}: attr {k!r} is not a "
                                 f"JSON scalar ({type(v).__name__})")
     return errs
+
+
+def _validate_expected(path, names: list, typ: str, registry, flag) -> list:
+    """Assert each name (a) belongs to ``registry`` — an unregistered
+    expectation means the caller and this tripwire drifted — and (b)
+    actually appears as a ``typ`` record in the trace at ``path``."""
+    errs = [f"{flag}: {n!r} is not a registered {typ} name"
+            for n in names if n not in registry]
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as exc:
+        return errs + [f"{path}: unreadable: {exc}"]
+    seen = set()
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # validate_trace reports malformed lines
+        if isinstance(rec, dict) and rec.get("type") == typ:
+            seen.add(rec.get("name"))
+    for n in names:
+        if n in registry and n not in seen:
+            errs.append(f"{path}: expected {typ} {n!r} not found in trace")
+    return errs
+
+
+def validate_expected_spans(path, names: list) -> list:
+    """Registered spans that MUST appear in the trace. Used to prove an
+    async-checkpointing run really overlapped its IO: a trace lacking
+    ``ckpt_snapshot``/``ckpt_write`` spans silently fell back to sync
+    saves."""
+    return _validate_expected(path, names, "span", SPAN_NAMES,
+                              "--expect-spans")
+
+
+def validate_expected_events(path, names: list) -> list:
+    """Registered events that MUST appear in the trace — e.g.
+    ``attempt_failed`` in a supervised-retry run's trace, or
+    ``checkpoint_write_failed`` when asserting a writer error was
+    mirrored and not silently dropped."""
+    return _validate_expected(path, names, "event", EVENT_NAMES,
+                              "--expect-events")
 
 
 def _validate_histogram(name: str, d: dict) -> list:
@@ -210,6 +279,26 @@ def validate_cli_report(path) -> list:
                 "payload_bytes"):
         if key not in doc:
             errs.append(f"{path}: missing key {key!r}")
+    io = doc.get("checkpoint_io")
+    if io is not None:
+        if not isinstance(io, dict):
+            errs.append(f"{path}: 'checkpoint_io' must be an object")
+        else:
+            for key in sorted(CHECKPOINT_IO_FIELDS - set(io)):
+                errs.append(f"{path}: checkpoint_io missing key {key!r}")
+            for key in sorted(set(io) - CHECKPOINT_IO_FIELDS):
+                errs.append(f"{path}: checkpoint_io key {key!r} is not in "
+                            "the known-field registry (runner accounting "
+                            "and validator drifted?)")
+            for key, v in io.items():
+                if key in _CHECKPOINT_IO_INTS:
+                    if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                        errs.append(f"{path}: checkpoint_io {key} must be "
+                                    "an int >= 0")
+                elif key in CHECKPOINT_IO_FIELDS:
+                    if not _num(v) or v < 0:
+                        errs.append(f"{path}: checkpoint_io {key} must be "
+                                    "a finite number >= 0")
     tel = doc.get("telemetry")
     if tel is None:
         return errs
@@ -234,15 +323,38 @@ def main(argv=None) -> int:
     ap.add_argument("--report", default="", help="RunReport JSON")
     ap.add_argument("--cli-report", default="",
                     help="the CLI's one-line JSON run report (saved "
-                         "stdout); telemetry counter names are checked "
-                         "against the known-name registry")
+                         "stdout); telemetry counter names and "
+                         "checkpoint_io fields are checked against the "
+                         "known-name registries")
+    ap.add_argument("--expect-spans", default="",
+                    help="comma-separated registered span names that MUST "
+                         "appear in --trace (e.g. 'ckpt_snapshot,"
+                         "ckpt_write' to prove a run checkpointed "
+                         "asynchronously)")
+    ap.add_argument("--expect-events", default="",
+                    help="comma-separated registered event names that MUST "
+                         "appear in --trace (e.g. 'attempt_failed' for a "
+                         "supervised-retry trace)")
     args = ap.parse_args(argv)
     if not (args.trace or args.metrics or args.report or args.cli_report):
         ap.error("nothing to validate: pass --trace/--metrics/--report/"
                  "--cli-report")
+    if (args.expect_spans or args.expect_events) and not args.trace:
+        ap.error("--expect-spans/--expect-events need --trace (they assert "
+                 "presence in that file)")
+
+    def _split(spec):
+        return [n.strip() for n in spec.split(",") if n.strip()]
+
     errs = []
     if args.trace:
         errs += validate_trace(args.trace)
+        if args.expect_spans:
+            errs += validate_expected_spans(args.trace,
+                                            _split(args.expect_spans))
+        if args.expect_events:
+            errs += validate_expected_events(args.trace,
+                                             _split(args.expect_events))
     if args.metrics:
         errs += validate_metrics(args.metrics)
     if args.report:
