@@ -8,11 +8,15 @@ Writes benchmarks/RESULTS.json and prints a table. Run on the TPU chip:
 
     python benchmarks/run_benchmarks.py [--quick] [--skip-oracle]
 
-The oracle is O(N^2) per round in delivery queries, so for the two giant
-configs (Paxos 10k x 10k, Raft 1k x 1k) the oracle is measured on a
-scaled-down config and reported as-is (scaling is linear in B*R and
-quadratic in N; the JSON records the exact config measured — no
-extrapolated numbers are reported as measurements).
+Oracle tractability: since the edge-wise delivery layer (cpp/oracle.cpp
+Net EDGE mode + the O(A·N) capped iteration, docs/PERF.md "oracle
+asymptotics"), the oracle runs every BASELINE config at its TRUE shape
+except raft-1kx1k — so each flagship row pairs the TPU digest with an
+oracle digest of the same config (benchmarks/parts/oracle-100k.json).
+raft-1kx1k is the one dense-semantics holdout (every pair queried ~7
+times over 1024 rounds ≈ 10^13 mixer evals single-core ≈ a day); it
+keeps a scaled-down oracle stand-in, recorded verbatim in the JSON — no
+extrapolated numbers are reported as measurements.
 """
 from __future__ import annotations
 
@@ -71,22 +75,30 @@ CONFIGS = {
 
 PBFT_FS = [1, 2, 4, 8, 16, 32, 64, 128]
 
-# Oracle-sized variants for the configs whose full size is intractable on
-# one CPU core (O(N^2) delivery per round).
+# Oracle-sized stand-ins — RETIRED for every capped/aggregate config now
+# that delivery is edge-wise (the raft-100k / pbft-100k-bcast /
+# paxos-10kx10k / dpos-100k rows run the oracle at their true flagship
+# shape; measured wall times in benchmarks/parts/oracle-100k.json and
+# docs/PERF.md). The one survivor is raft-1kx1k: dense SPEC §3 semantics
+# query ~every pair ~7x per round, so edge-wise buys nothing and the full
+# 8x1024x1024² run is ~a day single-core — it keeps a scaled-down config,
+# recorded verbatim (never extrapolated).
 ORACLE_SIZED = {
-    "raft-5node": dataclasses.replace(CONFIGS["raft-5node"], n_sweeps=8),
     "raft-1kx1k": dataclasses.replace(CONFIGS["raft-1kx1k"], n_sweeps=1,
                                       n_rounds=32),
-    "raft-100k": dataclasses.replace(CONFIGS["raft-100k"], n_nodes=2048,
-                                     n_sweeps=1, n_rounds=32),
-    "pbft-100k-bcast": dataclasses.replace(CONFIGS["pbft-100k-bcast"],
-                                           f=500, n_nodes=1501, n_sweeps=1,
-                                           n_rounds=16),
-    "paxos-10kx10k": dataclasses.replace(CONFIGS["paxos-10kx10k"],
-                                         n_nodes=1000, log_capacity=1000,
-                                         n_rounds=8),
-    "dpos-100k": dataclasses.replace(CONFIGS["dpos-100k"], n_rounds=64),
 }
+
+# Flagship-shape oracle rows are minutes-class, not seconds-class —
+# measure once instead of best-of-2 (single-core C++ has no warmup
+# effect worth a second multi-minute run).
+ORACLE_ONE_REPEAT = {"raft-100k", "pbft-100k-bcast", "paxos-10kx10k",
+                     "dpos-100k"}
+
+# Dispatch-bound configs: the whole 5-node run is sub-millisecond of
+# device time, so back-to-back separate dispatches time the tunnel's
+# jitter (±30% run-to-run in committed RESULTS) — time them as ONE
+# dispatch scanning over repeat lanes instead (time_tpu_repeat_scan).
+REPEAT_SCAN = {"raft-5node"}
 
 
 def time_tpu(cfg: Config, repeats: int = 3) -> dict:
@@ -136,6 +148,74 @@ def time_tpu(cfg: Config, repeats: int = 3) -> dict:
     steps = cfg.n_sweeps * cfg.n_nodes * cfg.n_rounds
     return {"engine": "tpu", "config": json.loads(cfg.to_json()),
             "steps": steps, "wall_s": best, "steps_per_sec": steps / best,
+            "digest": serialize.digest(payload),
+            "metrics": metrics_snap}
+
+
+def time_tpu_repeat_scan(cfg: Config, repeats: int = 8) -> dict:
+    """Dispatch-bound configs (REPEAT_SCAN): all timed repeats inside ONE
+    dispatch — a jitted ``lax.scan`` over repeat lanes, each lane a full
+    independent run (fresh carry from its own per-repeat seed vector,
+    offset (rep+1)·n_sweeps like time_tpu, then the same per-round
+    ``eng.round_fn`` scan the plain path times). The scan serializes the
+    lanes, so one dispatch's wall covers ``repeats`` real runs and the
+    per-run figure ``wall/repeats`` amortizes the dispatch+tunnel
+    overhead that made separate sub-millisecond dispatches read ±30%
+    run-to-run (the committed raft-5node rows). The compile/warmup call
+    uses a DIFFERENT seed matrix (offsets shifted by ``repeats``) so the
+    timed dispatch is never byte-identical to a prior one — the tunnel
+    dispatch cache can't replay it (PERF.md round 5). Digest epilogue:
+    a plain run_device at the base seed, same round kernel.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensus_tpu.core import serialize
+    from consensus_tpu.network import runner, simulator
+    from consensus_tpu.obs import metrics as obs_metrics
+    assert not cfg.mesh_shape, "repeat-scan timing is single-device only"
+    eng = simulator.engine_def(cfg)
+
+    def seed_mat(base_off: int) -> np.ndarray:  # [repeats, n_sweeps] u32
+        return np.stack([
+            runner.make_seeds(dataclasses.replace(
+                cfg, seed=cfg.seed + (base_off + rep + 1) * cfg.n_sweeps))
+            for rep in range(repeats)])
+
+    @jax.jit
+    def repeat_scan(mat):
+        def lane(carry, sv):
+            c = jax.vmap(lambda s: eng.make_carry(cfg, s))(sv)
+            xs = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
+            c, _ = jax.lax.scan(
+                lambda cc, r: (jax.vmap(
+                    lambda s: eng.round_fn(cfg, s, r))(cc), None), c, xs)
+            # Per-lane O(1) witness element; returning it as the scan
+            # output keeps every lane live (nothing for XLA to elide).
+            return carry, jax.tree.leaves(c)[0].ravel()[0]
+        _, w = jax.lax.scan(lane, jnp.uint32(0), mat)
+        return w
+
+    np.asarray(repeat_scan(seed_mat(repeats)))  # compile, distinct bytes
+    obs_metrics.reset()
+    t0 = time.perf_counter()
+    np.asarray(repeat_scan(seed_mat(0)))  # witness vector = sync barrier
+    dispatch_wall = time.perf_counter() - t0
+    metrics_snap = obs_metrics.snapshot()
+
+    # Digest epilogue at the base seed (outside the timed window).
+    carry = runner.run_device(cfg, eng)
+    out = {k: np.asarray(v) for k, v in eng.extract(carry).items()}
+    _, _, _, payload = simulator.decided_payload(cfg, out)
+    steps = cfg.n_sweeps * cfg.n_nodes * cfg.n_rounds  # per repeat lane
+    wall = dispatch_wall / repeats
+    return {"engine": "tpu", "config": json.loads(cfg.to_json()),
+            "steps": steps, "wall_s": wall,
+            "steps_per_sec": steps / wall,
+            "timing": "repeat-scan-one-dispatch",
+            "repeats_in_dispatch": repeats,
+            "dispatch_wall_s": dispatch_wall,
             "digest": serialize.digest(payload),
             "metrics": metrics_snap}
 
@@ -243,9 +323,12 @@ def main() -> None:
             continue
         row = {"name": name}
         if not args.skip_tpu:
-            row["tpu"] = time_tpu(cfg)
+            row["tpu"] = (time_tpu_repeat_scan(cfg) if name in REPEAT_SCAN
+                          else time_tpu(cfg))
         if not args.skip_oracle:
-            row["oracle"] = time_oracle(ORACLE_SIZED.get(name, cfg))
+            row["oracle"] = time_oracle(
+                ORACLE_SIZED.get(name, cfg),
+                repeats=1 if name in ORACLE_ONE_REPEAT else 2)
         results["rows"].append(row)
         _progress(row)
 

@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--mesh", default=argparse.SUPPRESS,
                     help="device mesh, e.g. '8' (sweep-parallel) or '2x4' "
                          "(sweep x node); TPU engine only")
+    ap.add_argument("--oracle-delivery", default="auto",
+                    choices=["auto", "dense", "edge"],
+                    help="cpu engine only: how the oracle answers delivery "
+                         "queries — dense materializes the [N,N] matrix per "
+                         "round, edge evaluates per-edge draws on demand "
+                         "(O(live edges)/round; what makes 100k-node capped "
+                         "configs oracle-tractable). Digests are identical "
+                         "for every value (docs/PERF.md)")
     ap.add_argument("--checkpoint", default="",
                     help="checkpoint file; resumes from the newest valid "
                          "(checksum-verified) rotation if present. "
@@ -261,6 +269,15 @@ def main(argv=None) -> int:
         if rejected:
             parser.error(f"{', '.join(rejected)}: only valid with "
                          f"--engine tpu (got --engine {cfg.engine})")
+    if args.oracle_delivery != "auto":
+        if cfg.engine != "cpu":
+            parser.error("--oracle-delivery is a cpu-oracle execution knob "
+                         "(cpp/oracle.cpp Net); the tpu engine has no [N,N] "
+                         "materialization to switch")
+        if cfg.protocol == "dpos":
+            parser.error("--oracle-delivery does not apply to dpos (its "
+                         "oracle queries one producer row per round — "
+                         "already edge-wise)")
 
     # Usage errors must fail fast — before any accelerator probe.
     if args.checkpoint and cfg.sweep_chunk and cfg.sweep_chunk < cfg.n_sweeps:
@@ -414,6 +431,8 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
                       sync_checkpoints=args.sync_checkpoints)
     if args.telemetry:
         run_kw["telemetry"] = True
+    if args.oracle_delivery != "auto":
+        run_kw["oracle_delivery"] = args.oracle_delivery
 
     if supervise:
         from .network import supervisor
@@ -426,7 +445,8 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
                 keep_checkpoints=keep,
                 fsync_checkpoints=args.fsync_checkpoints,
                 sync_checkpoints=args.sync_checkpoints,
-                telemetry=args.telemetry)
+                telemetry=args.telemetry,
+                oracle_delivery=args.oracle_delivery)
         except supervisor.SupervisorError as exc:
             # Park the give-up report for main's finally to dump.
             report_holder["run_report"] = exc.report.to_dict()

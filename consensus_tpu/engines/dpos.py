@@ -197,17 +197,28 @@ def lib_index(chain_p, chain_len, n_candidates: int, n_producers: int):
     T = (2 * n_producers) // 3 + 1
     lead = chain_p.shape[:-1]
     L = chain_p.shape[-1]
-    last_occ = np.full(lead + (n_candidates,), -1, np.int64)
-    for k in range(L):  # ascending k ⇒ later assignments win = last occ.
-        mask = k < chain_len
-        p = chain_p[..., k]
-        if lead:
-            idx = np.nonzero(mask)
-            last_occ[idx + (p[idx],)] = k
-        elif mask:
-            last_occ[p] = k
     if T > n_candidates:
         return np.full(lead, -1, np.int64)
+    # Per-candidate last occurrence, loop-free (the naive per-k loop was
+    # the one remaining host-side Python loop next to a hot path; at
+    # L in the thousands it dominated the extraction epilogue). Stable
+    # argsort groups each candidate's occurrences into a run with k
+    # ascending inside it, so the end of each run IS that candidate's
+    # last occurrence; invalid tail slots (k >= chain_len) sort into a
+    # sentinel run past every real candidate. Run ends are unique per
+    # (row, candidate), so one fancy-index scatter lands them all.
+    B = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    k_idx = np.arange(L, dtype=np.int64)
+    valid = k_idx < chain_len.reshape(B, 1)
+    p = np.where(valid, chain_p.reshape(B, L), n_candidates)
+    order = np.argsort(p, axis=-1, kind="stable")   # == k, sorted by p
+    p_sorted = np.take_along_axis(p, order, axis=-1)
+    run_end = np.ones((B, L), dtype=bool)
+    run_end[:, :-1] = p_sorted[:, 1:] != p_sorted[:, :-1]
+    rows, ends = np.nonzero(run_end)
+    lo = np.full((B, n_candidates + 1), -1, np.int64)  # +1: sentinel run
+    lo[rows, p_sorted[rows, ends]] = order[rows, ends]
+    last_occ = lo[:, :n_candidates].reshape(lead + (n_candidates,))
     lt = np.partition(last_occ, n_candidates - T, axis=-1)[..., n_candidates - T]
     return np.maximum(lt - 1, -1)
 

@@ -70,6 +70,17 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
     executed_rounds = cfg.n_rounds
     timing_includes_compile = False
     stats = None
+    # Oracle-only knob (cpp/engine.h oracle_delivery): how the C++ Net
+    # answers delivery queries — "auto" | "dense" | "edge". Execution
+    # strategy only; digests are identical for every value
+    # (tests/test_oracle_delivery.py), so the TPU engine rejects a
+    # non-default rather than silently ignoring it.
+    oracle_delivery = engine_kw.pop("oracle_delivery", "auto")
+    if cfg.engine == "tpu" and oracle_delivery != "auto":
+        raise ValueError(
+            f"oracle_delivery={oracle_delivery!r} is a cpu-oracle execution "
+            "knob (cpp/oracle.cpp Net); the tpu engine has no [N,N] "
+            "materialization to switch and would silently ignore it")
     if cfg.engine == "tpu":
         # Honor a caller-provided stats dict (it is filled in place by
         # runner.run) instead of silently shadowing it with our own.
@@ -106,8 +117,9 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
         bindings.get_lib()  # build outside the timed window
         t0 = time.perf_counter()
         with obs_trace.span("oracle_run", protocol=cfg.protocol,
-                            n_sweeps=cfg.n_sweeps):
-            out = _run_oracle(cfg)
+                            n_sweeps=cfg.n_sweeps,
+                            oracle_delivery=oracle_delivery):
+            out = _run_oracle(cfg, delivery=oracle_delivery)
         wall = time.perf_counter() - t0
 
     counts, rec_a, rec_b, payload = decided_payload(cfg, out)
@@ -202,12 +214,21 @@ def _run_jax(cfg: Config, **engine_kw):
     return runner.run(cfg, engine_def(cfg), **engine_kw)
 
 
-def _run_oracle(cfg: Config):
+def _run_oracle(cfg: Config, delivery: str = "auto"):
     from ..oracle import bindings
     runners = {"raft": bindings.raft_run, "paxos": bindings.paxos_run,
                "pbft": bindings.pbft_run, "dpos": bindings.dpos_run}
     if cfg.protocol not in runners:
         raise NotImplementedError(cfg.protocol)
     fn = runners[cfg.protocol]
-    outs = [fn(cfg, sweep=b) for b in range(cfg.n_sweeps)]
+    if cfg.protocol == "dpos":
+        # DPoS has no [N, N] delivery layer to switch (one producer row
+        # per round is already edge-wise) — reject rather than ignore.
+        if delivery != "auto":
+            raise ValueError("oracle_delivery does not apply to dpos (its "
+                             "oracle queries one producer row per round)")
+        kw = {}
+    else:
+        kw = {"delivery": delivery}
+    outs = [fn(cfg, sweep=b, **kw) for b in range(cfg.n_sweeps)]
     return {k: np.stack([o[k] for o in outs]) for k in outs[0]}

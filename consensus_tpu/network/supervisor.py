@@ -110,6 +110,7 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                    sync_checkpoints: bool = False,
                    mesh=None, seeds=None,
                    warmup: bool = False, telemetry: bool = False,
+                   oracle_delivery: str = "auto",
                    sleep=time.sleep):
     """Run ``cfg`` under supervision; return the :class:`RunResult` with
     ``extras["run_report"]`` filled in.
@@ -183,6 +184,10 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
         raise ValueError("telemetry is reduced inside the tpu engine's "
                          f"scan body (cfg.engine={cfg.engine!r} has no "
                          "on-device counters)")
+    if oracle_delivery != "auto" and cfg.engine != "cpu":
+        raise ValueError("oracle_delivery is a cpu-oracle execution knob "
+                         f"(cfg.engine={cfg.engine!r}); simulator.run would "
+                         "reject it on every attempt")
 
     report = RunReport(retries=retries)
     t_start = time.monotonic()
@@ -199,6 +204,8 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
         # separate peek re-reading and re-verifying the snapshot.
         stats: dict = {}
         kw = {}
+        if oracle_delivery != "auto":
+            kw["oracle_delivery"] = oracle_delivery
         if cfg.engine == "tpu":
             kw["stats"] = stats
             if telemetry:

@@ -20,6 +20,23 @@ _CPP_DIR = pathlib.Path(__file__).resolve().parents[2] / "cpp"
 _LIB_PATH = _CPP_DIR / "liboracle.so"
 _lib = None
 
+# SimConfig::oracle_delivery (cpp/engine.h): how the oracle's Net answers
+# delivery queries. Execution strategy only — decided logs are
+# byte-identical for every value (tests/test_oracle_delivery.py):
+#   auto  — per-engine choice (edge-wise for the capped engines);
+#   dense — materialize the [N, N] matrix per round (the historic path);
+#   edge  — on-demand per-edge draws, O(live edges) per round: what makes
+#           the 100k-node capped configs oracle-tractable (docs/PERF.md).
+DELIVERY = {"auto": 0, "dense": 1, "edge": 2}
+
+
+def _delivery_code(delivery) -> int:
+    try:
+        return DELIVERY[delivery]
+    except KeyError:
+        raise ValueError(f"unknown oracle delivery {delivery!r} "
+                         f"(expected one of {sorted(DELIVERY)})")
+
 
 def _build() -> None:
     subprocess.run(["make", "-C", str(_CPP_DIR), "-s"], check=True)
@@ -29,7 +46,8 @@ def get_lib() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    src_mtime = max((_CPP_DIR / f).stat().st_mtime for f in ("oracle.cpp", "threefry.h"))
+    src_mtime = max((_CPP_DIR / f).stat().st_mtime
+                    for f in ("oracle.cpp", "engine.h", "threefry.h"))
     if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < src_mtime:
         _build()
     lib = ctypes.CDLL(str(_LIB_PATH))
@@ -40,12 +58,12 @@ def get_lib() -> ctypes.CDLL:
     lib.ctpu_delivery_u32.restype = u32
     lib.ctpu_delivery_u32.argtypes = [u64, u32, u32, u32]
     lib.ctpu_raft_run.restype = ctypes.c_int
-    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 12 + [p32] * 5
+    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 13 + [p32] * 5
     p8 = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
     lib.ctpu_paxos_run.restype = ctypes.c_int
-    lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 7 + [p32, p8, p32, p32, p32]
+    lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 8 + [p32, p8, p32, p32, p32]
     lib.ctpu_pbft_run.restype = ctypes.c_int
-    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 11 + [p8, p32, p32]
+    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 12 + [p8, p32, p32]
     pi32 = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
     lib.ctpu_dpos_run.restype = ctypes.c_int
     lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 9 + [p32] * 3 + [pi32]
@@ -62,7 +80,7 @@ def delivery_u32(seed: int, r: int, i: int, j: int) -> int:
     return int(get_lib().ctpu_delivery_u32(seed, r, i, j))
 
 
-def raft_run(cfg, sweep: int = 0):
+def raft_run(cfg, sweep: int = 0, delivery: str = "auto"):
     """Run one Raft sweep in the oracle. Returns dict of final arrays."""
     lib = get_lib()
     N, L = cfg.n_nodes, cfg.log_capacity
@@ -79,6 +97,7 @@ def raft_run(cfg, sweep: int = 0):
         cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
         cfg.max_active,
         cfg.n_byzantine, 1 if cfg.byz_mode == "equivocate" else 0,
+        _delivery_code(delivery),
         out["commit"], out["log_term"].reshape(-1), out["log_val"].reshape(-1),
         out["term"], out["role"])
     if rc != 0:
@@ -86,7 +105,7 @@ def raft_run(cfg, sweep: int = 0):
     return out
 
 
-def paxos_run(cfg, sweep: int = 0):
+def paxos_run(cfg, sweep: int = 0, delivery: str = "auto"):
     """Run one Paxos sweep in the oracle. Returns dict of final arrays."""
     lib = get_lib()
     N, S = cfg.n_nodes, cfg.log_capacity
@@ -101,6 +120,7 @@ def paxos_run(cfg, sweep: int = 0):
     rc = lib.ctpu_paxos_run(
         seed, N, cfg.n_rounds, S, cfg.n_proposers,
         cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
+        _delivery_code(delivery),
         out["learned_val"].reshape(-1), out["learned_mask"].reshape(-1),
         out["promised"].reshape(-1), out["acc_bal"].reshape(-1),
         out["acc_val"].reshape(-1))
@@ -109,7 +129,7 @@ def paxos_run(cfg, sweep: int = 0):
     return out
 
 
-def pbft_run(cfg, sweep: int = 0):
+def pbft_run(cfg, sweep: int = 0, delivery: str = "auto"):
     """Run one PBFT sweep in the oracle. Returns dict of final arrays."""
     lib = get_lib()
     N, S = cfg.n_nodes, cfg.log_capacity
@@ -124,6 +144,7 @@ def pbft_run(cfg, sweep: int = 0):
         1 if cfg.byz_mode == "equivocate" else 0,
         1 if cfg.fault_model == "bcast" else 0,
         cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
+        _delivery_code(delivery),
         out["committed"].reshape(-1), out["dval"].reshape(-1), out["view"])
     if rc != 0:
         raise RuntimeError(f"oracle pbft_run failed rc={rc}")

@@ -43,6 +43,10 @@ struct Args {
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
   std::string byz_mode = "silent";
   std::string fault_model = "edge";  // "edge" (SPEC §2) | "bcast" (§6b, pbft)
+  // Oracle delivery strategy (execution only, digests unchanged):
+  // "auto" (per-engine choice), "dense" ([N,N] materialization), or
+  // "edge" (on-demand edge queries — the cross-check knob).
+  std::string oracle_delivery = "auto";
   uint32_t n_proposers = 0;
   uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;
   std::string out_path;  // optional: dump raw payload bytes
@@ -69,6 +73,7 @@ uint32_t prob_threshold_u32(double p) {
       "  [--drop-rate P] [--partition-rate P] [--churn-rate P]\n"
       "  [--f F] [--view-timeout T] [--n-byzantine K]\n"
       "  [--byz-mode silent|equivocate] [--fault-model edge|bcast]\n"
+      "  [--oracle-delivery auto|dense|edge]  (cpu engine; digests equal)\n"
       "  [--n-proposers P]\n"
       "  [--candidates C] [--producers K] [--epoch-len E] [--out FILE]\n",
       argv0);
@@ -105,6 +110,7 @@ Args parse(int argc, char** argv) {
     else if (k == "--n-byzantine") a.n_byzantine = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--byz-mode") a.byz_mode = need(k.c_str());
     else if (k == "--fault-model") a.fault_model = need(k.c_str());
+    else if (k == "--oracle-delivery") a.oracle_delivery = need(k.c_str());
     else if (k == "--n-proposers") a.n_proposers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--candidates") a.n_candidates = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--producers") a.n_producers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
@@ -126,6 +132,19 @@ Args parse(int argc, char** argv) {
     std::fprintf(stderr,
                  "--fault-model bcast (SPEC 6b) is a pbft model; %s would "
                  "silently ignore it\n", a.protocol.c_str());
+    std::exit(2);
+  }
+  if (a.oracle_delivery != "auto" && a.oracle_delivery != "dense" &&
+      a.oracle_delivery != "edge") {
+    std::fprintf(stderr, "unknown --oracle-delivery %s\n",
+                 a.oracle_delivery.c_str());
+    std::exit(2);
+  }
+  if (a.oracle_delivery != "auto" && a.protocol == "dpos") {
+    std::fprintf(stderr,
+                 "--oracle-delivery: dpos has no [N,N] delivery layer (one "
+                 "producer row per round is already edge-wise); the flag "
+                 "would be silently ignored\n");
     std::exit(2);
   }
   return a;
@@ -191,6 +210,8 @@ int run_cpu(const Args& a) {
   cfg.n_candidates = a.n_candidates;
   cfg.n_producers = a.n_producers;
   cfg.epoch_len = a.epoch_len;
+  cfg.oracle_delivery = a.oracle_delivery == "dense" ? 1
+                        : a.oracle_delivery == "edge" ? 2 : 0;
 
   Payload pl;
   pl.header(uint8_t(proto_id), B, N);

@@ -32,6 +32,12 @@ struct SimConfig {
   uint32_t fault_bcast = 0;     // pbft fault_model == "bcast" (SPEC §6b)
   uint32_t n_proposers = 0;                            // paxos
   uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;  // dpos
+  // Oracle delivery-layer strategy (execution only — decided logs are
+  // byte-identical either way, SPEC §2 draws are pure counter functions):
+  // 0 = auto (per-engine choice), 1 = dense [N,N] materialization,
+  // 2 = on-demand edge-wise queries (O(live edges) per round — what makes
+  // the capped 100k-node configs oracle-tractable, docs/PERF.md).
+  uint32_t oracle_delivery = 0;
 };
 
 // A consensus engine: run the whole simulation, then expose each node's

@@ -13,6 +13,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <memory>
 #include <string>
 #include <vector>
@@ -26,37 +27,76 @@ namespace {
 constexpr uint32_t ROLE_F = 0, ROLE_C = 1, ROLE_L = 2;
 constexpr int32_t NONE = -1;
 
-// Per-round delivery decisions (SPEC §2), materialized once per round —
-// each directed edge is queried up to ~7 times per round across the phases,
-// so recomputing the 20-round threefry per query would distort the
-// single-core baseline this oracle exists to provide (BASELINE.md).
+// SimConfig::oracle_delivery values (engine.h): how Net answers queries.
+constexpr uint32_t DELIVERY_AUTO = 0, DELIVERY_DENSE = 1, DELIVERY_EDGE = 2;
+
+// Per-round delivery decisions (SPEC §2), in one of two byte-identical
+// strategies:
+//
+//  * DENSE — materialize the full [N, N] matrix once per round. Each
+//    directed edge is queried up to ~7 times per round across the dense
+//    engines' phases, so paying the mixer chain once per edge is the
+//    right trade when ~every edge is live (the pre-edge-wise design,
+//    still the single-core baseline for the dense SPEC §3 / §6 rounds).
+//  * EDGE — answer each query on demand from the counter-based draw.
+//    The capped engines (SPEC §3b Raft, Paxos with few proposers) only
+//    ever query O(A·N) live edges, so the O(N²) materialization was
+//    pure waste — 10 GB and ~10¹⁰ mixer chains per 100k-node run that
+//    the queries never looked at (VERDICT r5 missing #1). The per-sender
+//    absorb ``hi[i]`` is hoisted once per round (O(N)), so a query is
+//    one absorb + one finalize + the partition side check.
+//
+// Both strategies evaluate the SAME pure function of (seed, r, i, j) —
+// the mixer chain and the partition side draws are keyed by absolute
+// ids — so digests cannot depend on the choice (tested per engine in
+// tests/test_oracle_delivery.py).
 struct Net {
   uint32_t n = 0;
-  std::vector<uint8_t> mat;  // [n*n] delivered?
+  uint32_t drop_cut = 0;
+  bool part_active = false;
+  bool edge_mode = false;
+  std::vector<uint8_t> side;  // [n]; filled only when part_active
+  std::vector<uint32_t> hi;   // [n] edge mode: per-sender hoisted absorb
+  std::vector<uint8_t> mat;   // [n*n] dense mode: delivered?
 
-  void begin_round(uint64_t seed, uint32_t n_, uint32_t r, uint32_t drop_cut,
-                   uint32_t part_cut) {
+  void begin_round(uint64_t seed, uint32_t n_, uint32_t r, uint32_t drop_cut_,
+                   uint32_t part_cut, bool edge) {
     n = n_;
-    mat.assign(size_t(n) * n, 0);
-    const bool part_active =
-        random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
-    std::vector<uint8_t> side(n, 0);
-    if (part_active)
+    drop_cut = drop_cut_;
+    edge_mode = edge;
+    part_active = random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
+    if (part_active) {
+      side.resize(n);
       for (uint32_t i = 0; i < n; ++i)
         side[i] = random_u32(seed, STREAM_PARTITION, r, 1, i) & 1u;
+    }
     const uint32_t hr = mix_absorb(
         static_cast<uint32_t>(seed & 0xFFFFFFFFull) ^ STREAM_DELIVER, r);
+    if (edge_mode) {
+      mat.clear();
+      hi.resize(n);
+      for (uint32_t i = 0; i < n; ++i) hi[i] = mix_absorb(hr, i);
+      return;
+    }
+    mat.assign(size_t(n) * n, 0);
     for (uint32_t i = 0; i < n; ++i) {
-      const uint32_t hi = mix_absorb(hr, i);
+      const uint32_t h = mix_absorb(hr, i);
       for (uint32_t j = 0; j < n; ++j) {
         if (i == j) continue;
-        if (mix_fin(mix_absorb(hi, j)) < drop_cut) continue;
+        if (mix_fin(mix_absorb(h, j)) < drop_cut) continue;
         if (part_active && side[i] != side[j]) continue;
         mat[size_t(i) * n + j] = 1;
       }
     }
   }
+  // The SPEC §2 edge decision for i → j (drop ∘ partition ∘ no-self).
+  bool edge(uint32_t i, uint32_t j) const {
+    if (i == j) return false;
+    if (mix_fin(mix_absorb(hi[i], j)) < drop_cut) return false;
+    return !part_active || side[i] == side[j];
+  }
   bool delivered(uint32_t i, uint32_t j) const {
+    if (edge_mode) return edge(i, j);
     return mat[size_t(i) * n + j] != 0;
   }
 };
@@ -77,6 +117,15 @@ struct RaftSim {
   // SPEC §3c byzantine minority (ids >= N - n_byz): byz_equiv = 0 ->
   // "silent" (withhold every send), 1 -> "equivocate" (double-grant).
   uint32_t n_byz = 0, byz_equiv = 0;
+  uint32_t delivery = DELIVERY_AUTO;
+
+  // Auto: the capped round queries only O(A·N) edges — edge-wise makes
+  // it tractable at 100k nodes; the dense round touches ~every edge ~7
+  // times, so the materialized matrix stays the better baseline there.
+  bool edge_net() const {
+    if (delivery == DELIVERY_AUTO) return A > 0;
+    return delivery == DELIVERY_EDGE;
+  }
 
   // State, struct-of-arrays to mirror the array schema (SURVEY.md §7).
   std::vector<uint32_t> term, role, log_len, commit, timer, timeout;
@@ -141,7 +190,7 @@ struct RaftSim {
 
   void round(uint32_t r) {
     const uint32_t majority = N / 2 + 1;
-    net.begin_round(seed, N, r, drop_cut, part_cut);
+    net.begin_round(seed, N, r, drop_cut, part_cut, edge_net());
     std::vector<uint8_t> reset(N, 0);
 
     // ---- P0 churn: all leaders step down.
@@ -317,9 +366,16 @@ struct RaftSim {
   // bookkeeping lives in A tracked [A, N] rows instead of [N, N].
   // Scalar twin of engines/raft_sparse.py (decided logs bit-equal to the
   // dense semantics whenever concurrent sender counts stay <= A).
+  //
+  // O(A·N) per round end to end: delivery is queried edge-wise (under
+  // the default auto mode) and every per-receiver loop below iterates
+  // the ≤A active sender ids, never the population — the two
+  // together are what let the oracle run the 100k-node flagship config
+  // in seconds instead of materializing ~10¹⁰ matrix cells
+  // (docs/PERF.md "oracle asymptotics").
   void round_capped(uint32_t r) {
     const uint32_t majority = N / 2 + 1;
-    net.begin_round(seed, N, r, drop_cut, part_cut);
+    net.begin_round(seed, N, r, drop_cut, part_cut, edge_net());
     std::vector<uint8_t> reset(N, 0);
 
     // ---- P0 churn.
@@ -344,20 +400,26 @@ struct RaftSim {
                    (!withhold() || honest(i));  // SPEC §3c silent byz
     const std::vector<int32_t> cand_ids = top_active(is_cand);
     std::vector<uint8_t> active_cand(N, 0);
+    // The active ids again, ascending — the ONLY senders the P2a/P2b
+    // receiver loops may visit (an O(N) scan per receiver here was the
+    // residual O(N²) term after delivery went edge-wise); ascending
+    // order preserves the lowest-id-first grant tie-break verbatim.
+    std::vector<uint32_t> act_asc;
+    act_asc.reserve(A);
     for (int32_t c : cand_ids)
-      if (c >= 0) active_cand[c] = 1;
+      if (c >= 0) { active_cand[c] = 1; act_asc.push_back(uint32_t(c)); }
+    std::sort(act_asc.begin(), act_asc.end());
     std::vector<uint32_t> req_term(N, 0), req_lidx(N, 0), req_lterm(N, 0);
-    for (uint32_t c = 0; c < N; ++c)
-      if (active_cand[c]) {
-        req_term[c] = term[c];
-        req_lidx[c] = log_len[c];
-        req_lterm[c] = log_len[c] ? lt(c, log_len[c] - 1) : 0;
-      }
+    for (uint32_t c : act_asc) {
+      req_term[c] = term[c];
+      req_lidx[c] = log_len[c];
+      req_lterm[c] = log_len[c] ? lt(c, log_len[c] - 1) : 0;
+    }
     // P2a: term catch-up from delivered active requests.
     for (uint32_t j = 0; j < N; ++j) {
       uint32_t T = term[j];
-      for (uint32_t c = 0; c < N; ++c)
-        if (active_cand[c] && net.delivered(c, j)) T = std::max(T, req_term[c]);
+      for (uint32_t c : act_asc)
+        if (net.delivered(c, j)) T = std::max(T, req_term[c]);
       if (T > term[j]) bump_term(j, T);
     }
     // P2b: grants (eligibility restricted to active candidates).
@@ -374,7 +436,7 @@ struct RaftSim {
       if (voted_for[j] != NONE) {
         if (eligible(uint32_t(voted_for[j]))) g = voted_for[j];  // re-grant
       } else {
-        for (uint32_t c = 0; c < N; ++c)
+        for (uint32_t c : act_asc)
           if (eligible(c)) { g = int32_t(c); break; }  // lowest id
       }
       if (g != NONE) { voted_for[j] = g; timer[j] = 0; reset[j] = 1; }
@@ -551,6 +613,21 @@ struct PbftSim {
   uint32_t equiv = 0;        // byz_mode == "equivocate" (SPEC §6)
   uint32_t fault_bcast = 0;  // SPEC §6b broadcast-atomic fault model
   uint32_t drop_cut, part_cut, churn_cut;
+  uint32_t delivery = DELIVERY_AUTO;
+
+  // The §6 dense tallies walk ~every (i, j) pair anyway, so the
+  // materialized Net stays the auto choice for the edge fault model;
+  // forcing DELIVERY_EDGE is the small-N cross-check knob.
+  bool edge_net() const { return delivery == DELIVERY_EDGE; }
+  // §6b only: under broadcast-atomic faults every per-receiver multiset
+  // is side-separable, so P1/P4/P5/P6 reduce to per-(slot, side)
+  // aggregates — O(N·S) per round instead of O(N²·S), which is what
+  // lets the oracle run pbft-100k-bcast at its benchmark shape.
+  // DELIVERY_DENSE forces the direct per-receiver §6b definition — kept
+  // alive as an independent derivation the differential tests
+  // cross-check against both this fast path and the engine's
+  // sorted-space formulation.
+  bool bcast_fast() const { return fault_bcast && delivery != DELIVERY_DENSE; }
 
   std::vector<uint32_t> view, timer;                    // [N]
   std::vector<uint8_t> pp_seen, prepared, committed;    // [N*S]
@@ -613,156 +690,321 @@ struct PbftSim {
     committed.assign(size_t(N) * S, 0);
     pp_view.assign(size_t(N) * S, 0); pp_val.assign(size_t(N) * S, 0);
     dval.assign(size_t(N) * S, 0);
-    const uint32_t Q = 2 * f + 1;
-
-    std::vector<uint8_t> reset(N), new_commit(N);
-    std::vector<uint32_t> views_in;  // for the f+1 rule
-    // Phase snapshots.
-    std::vector<uint32_t> s_view(N);
-    std::vector<uint8_t> s_ppb;      // [N*S] pre-prepare broadcast set
-    std::vector<uint32_t> s_msgval;  // [N*S]
-    std::vector<uint8_t> s_seen, s_prep, s_comm;
-    std::vector<uint32_t> s_val, s_dval;
-
     for (uint32_t r = 0; r < R; ++r) {
       if (fault_bcast)
         bnet.begin_round(seed, N, r, drop_cut, part_cut);
       else
-        net.begin_round(seed, N, r, drop_cut, part_cut);
-      std::fill(reset.begin(), reset.end(), 0);
-      std::fill(new_commit.begin(), new_commit.end(), 0);
+        net.begin_round(seed, N, r, drop_cut, part_cut, edge_net());
+      if (bcast_fast())
+        round_bcast_fast(r);
+      else
+        round_direct(r);
+    }
+  }
 
-      // P0 churn.
-      if (churn_fires(seed, r, churn_cut))
-        for (uint32_t i = 0; i < N; ++i) {
-          view[i] += 1; timer[i] = 0; reset[i] = 1;
-        }
-
-      // P1 view catch-up ((f+1)-th largest delivered honest view ∪ own).
-      s_view = view;
-      for (uint32_t j = 0; j < N; ++j) {
-        views_in.clear();
-        views_in.push_back(s_view[j]);
-        for (uint32_t i = 0; i < N; ++i)
-          if (i != j && honest(i) && del(r, i, j))
-            views_in.push_back(s_view[i]);
-        if (views_in.size() >= f + 1) {
-          std::nth_element(views_in.begin(), views_in.begin() + f,
-                           views_in.end(), std::greater<uint32_t>());
-          uint32_t vth = views_in[f];
-          if (vth > view[j]) { view[j] = vth; timer[j] = 0; reset[j] = 1; }
+  // P3 pre-prepare — shared verbatim by the direct and aggregate rounds
+  // (one sender per receiver, O(N·S); delivery and equivocation stance
+  // dispatch through del()/eq_sup()). Snapshot sender state post-P2.
+  void phase_preprepare(uint32_t r) {
+    const std::vector<uint32_t> s_view = view;
+    std::vector<uint8_t> s_ppb(size_t(N) * S, 0);    // pre-prepare bcast set
+    std::vector<uint32_t> s_msgval(size_t(N) * S, 0);
+    for (uint32_t i = 0; i < N; ++i) {
+      if (!honest(i) || s_view[i] % N != i) continue;
+      uint32_t fresh = S;
+      for (uint32_t s = 0; s < S; ++s)
+        if (!pp_seen[at(i, s)]) { fresh = s; break; }
+      for (uint32_t s = 0; s < S; ++s) {
+        bool reissue = pp_seen[at(i, s)] && !committed[at(i, s)];
+        if (reissue || s == fresh) {
+          s_ppb[at(i, s)] = 1;
+          s_msgval[at(i, s)] = pp_seen[at(i, s)]
+              ? pp_val[at(i, s)]
+              : random_u32(seed, STREAM_VALUE, s_view[i], 2, s);
         }
       }
-
-      // P2 timeout.
-      for (uint32_t j = 0; j < N; ++j)
-        if (timer[j] >= view_timeout) {
-          view[j] += 1; timer[j] = 0; reset[j] = 1;
+    }
+    for (uint32_t j = 0; j < N; ++j) {
+      uint32_t prim = view[j] % N;
+      bool prim_byz = equiv && !honest(prim);
+      bool pdel = prim == j || del(r, prim, j);
+      // A byz primary lies about its view, so only delivery gates it;
+      // it offers EVERY slot, per-receiver conflicting values.
+      bool ok = prim_byz ? pdel : (pdel && s_view[prim] == view[j]);
+      if (!ok) continue;
+      for (uint32_t s = 0; s < S; ++s) {
+        uint32_t v;
+        if (prim_byz) {
+          v = random_u32(seed, STREAM_VALUE, view[j],
+                         eq_sup(r, prim, j) ? 4 : 3, s);
+        } else {
+          if (!s_ppb[at(prim, s)]) continue;
+          v = s_msgval[at(prim, s)];
         }
+        if (pp_seen[at(j, s)] && pp_view[at(j, s)] >= view[j]) continue;
+        if (prepared[at(j, s)] && v != pp_val[at(j, s)]) continue;
+        pp_seen[at(j, s)] = 1;
+        pp_view[at(j, s)] = view[j];
+        pp_val[at(j, s)] = v;
+      }
+    }
+  }
 
-      // P3 pre-prepare. Snapshot sender state (post-P2).
-      s_view = view;
-      s_ppb.assign(size_t(N) * S, 0);
-      s_msgval.assign(size_t(N) * S, 0);
+  // One SPEC §6 / §6b round straight from the per-receiver definition
+  // (O(N²·S) tallies) — the small-N reference the aggregate §6b round
+  // below (and the engines' formulations) are cross-checked against.
+  void round_direct(uint32_t r) {
+    const uint32_t Q = 2 * f + 1;
+    std::vector<uint8_t> reset(N, 0), new_commit(N, 0);
+    std::vector<uint32_t> views_in;  // for the f+1 rule
+    std::vector<uint32_t> s_view(N);
+    std::vector<uint8_t> s_seen, s_prep, s_comm;
+    std::vector<uint32_t> s_val, s_dval;
+
+    // P0 churn.
+    if (churn_fires(seed, r, churn_cut))
       for (uint32_t i = 0; i < N; ++i) {
-        if (!honest(i) || s_view[i] % N != i) continue;
-        uint32_t fresh = S;
-        for (uint32_t s = 0; s < S; ++s)
-          if (!pp_seen[at(i, s)]) { fresh = s; break; }
-        for (uint32_t s = 0; s < S; ++s) {
-          bool reissue = pp_seen[at(i, s)] && !committed[at(i, s)];
-          if (reissue || s == fresh) {
-            s_ppb[at(i, s)] = 1;
-            s_msgval[at(i, s)] = pp_seen[at(i, s)]
-                ? pp_val[at(i, s)]
-                : random_u32(seed, STREAM_VALUE, s_view[i], 2, s);
-          }
-        }
+        view[i] += 1; timer[i] = 0; reset[i] = 1;
       }
-      for (uint32_t j = 0; j < N; ++j) {
-        uint32_t prim = view[j] % N;
-        bool prim_byz = equiv && !honest(prim);
-        bool pdel = prim == j || del(r, prim, j);
-        // A byz primary lies about its view, so only delivery gates it;
-        // it offers EVERY slot, per-receiver conflicting values.
-        bool ok = prim_byz ? pdel : (pdel && s_view[prim] == view[j]);
-        if (!ok) continue;
-        for (uint32_t s = 0; s < S; ++s) {
-          uint32_t v;
-          if (prim_byz) {
-            v = random_u32(seed, STREAM_VALUE, view[j],
-                           eq_sup(r, prim, j) ? 4 : 3, s);
-          } else {
-            if (!s_ppb[at(prim, s)]) continue;
-            v = s_msgval[at(prim, s)];
-          }
-          if (pp_seen[at(j, s)] && pp_view[at(j, s)] >= view[j]) continue;
-          if (prepared[at(j, s)] && v != pp_val[at(j, s)]) continue;
-          pp_seen[at(j, s)] = 1;
-          pp_view[at(j, s)] = view[j];
-          pp_val[at(j, s)] = v;
+
+    // P1 view catch-up ((f+1)-th largest delivered honest view ∪ own).
+    s_view = view;
+    for (uint32_t j = 0; j < N; ++j) {
+      views_in.clear();
+      views_in.push_back(s_view[j]);
+      for (uint32_t i = 0; i < N; ++i)
+        if (i != j && honest(i) && del(r, i, j))
+          views_in.push_back(s_view[i]);
+      if (views_in.size() >= f + 1) {
+        std::nth_element(views_in.begin(), views_in.begin() + f,
+                         views_in.end(), std::greater<uint32_t>());
+        uint32_t vth = views_in[f];
+        if (vth > view[j]) { view[j] = vth; timer[j] = 0; reset[j] = 1; }
+      }
+    }
+
+    // P2 timeout.
+    for (uint32_t j = 0; j < N; ++j)
+      if (timer[j] >= view_timeout) {
+        view[j] += 1; timer[j] = 0; reset[j] = 1;
+      }
+
+    // P3 pre-prepare (shared).
+    phase_preprepare(r);
+
+    // P4 prepare tally (value-matched, incl. self). Snapshot post-P3.
+    s_seen = pp_seen; s_val = pp_val;
+    for (uint32_t j = 0; j < N; ++j)
+      for (uint32_t s = 0; s < S; ++s) {
+        if (!s_seen[at(j, s)] || prepared[at(j, s)]) continue;
+        uint32_t cnt = 0;
+        for (uint32_t i = 0; i < N; ++i) {
+          if (honest(i) && s_seen[at(i, s)] &&
+              s_val[at(i, s)] == s_val[at(j, s)] &&
+              (i == j || del(r, i, j)))
+            ++cnt;
+          else if (equiv && !honest(i) && i != j && del(r, i, j) &&
+                   eq_sup(r, i, j))
+            ++cnt;  // byz i claims j's exact value iff its stance coin
+        }
+        if (cnt >= Q) prepared[at(j, s)] = 1;
+      }
+
+    // P5 commit tally. Snapshot prepared post-P4.
+    s_prep = prepared;
+    for (uint32_t j = 0; j < N; ++j)
+      for (uint32_t s = 0; s < S; ++s) {
+        if (!s_prep[at(j, s)] || committed[at(j, s)]) continue;
+        uint32_t cnt = 0;
+        for (uint32_t i = 0; i < N; ++i) {
+          if (honest(i) && s_prep[at(i, s)] &&
+              s_val[at(i, s)] == s_val[at(j, s)] &&
+              (i == j || del(r, i, j)))
+            ++cnt;
+          else if (equiv && !honest(i) && i != j && del(r, i, j) &&
+                   eq_sup(r, i, j))
+            ++cnt;
+        }
+        if (cnt >= Q) {
+          committed[at(j, s)] = 1;
+          dval[at(j, s)] = pp_val[at(j, s)];
+          new_commit[j] = 1;
         }
       }
 
-      // P4 prepare tally (value-matched, incl. self). Snapshot post-P3.
-      s_seen = pp_seen; s_val = pp_val;
-      for (uint32_t j = 0; j < N; ++j)
-        for (uint32_t s = 0; s < S; ++s) {
-          if (!s_seen[at(j, s)] || prepared[at(j, s)]) continue;
-          uint32_t cnt = 0;
-          for (uint32_t i = 0; i < N; ++i) {
-            if (honest(i) && s_seen[at(i, s)] &&
-                s_val[at(i, s)] == s_val[at(j, s)] &&
-                (i == j || del(r, i, j)))
-              ++cnt;
-            else if (equiv && !honest(i) && i != j && del(r, i, j) &&
-                     eq_sup(r, i, j))
-              ++cnt;  // byz i claims j's exact value iff its stance coin
-          }
-          if (cnt >= Q) prepared[at(j, s)] = 1;
-        }
-
-      // P5 commit tally. Snapshot prepared post-P4.
-      s_prep = prepared;
-      for (uint32_t j = 0; j < N; ++j)
-        for (uint32_t s = 0; s < S; ++s) {
-          if (!s_prep[at(j, s)] || committed[at(j, s)]) continue;
-          uint32_t cnt = 0;
-          for (uint32_t i = 0; i < N; ++i) {
-            if (honest(i) && s_prep[at(i, s)] &&
-                s_val[at(i, s)] == s_val[at(j, s)] &&
-                (i == j || del(r, i, j)))
-              ++cnt;
-            else if (equiv && !honest(i) && i != j && del(r, i, j) &&
-                     eq_sup(r, i, j))
-              ++cnt;
-          }
-          if (cnt >= Q) {
+    // P6 decide gossip. Snapshot committed post-P5.
+    s_comm = committed; s_dval = dval;
+    for (uint32_t j = 0; j < N; ++j)
+      for (uint32_t s = 0; s < S; ++s) {
+        if (s_comm[at(j, s)]) continue;
+        for (uint32_t i = 0; i < N; ++i)  // ascending ⇒ lowest id wins
+          if (honest(i) && s_comm[at(i, s)] && del(r, i, j)) {
             committed[at(j, s)] = 1;
-            dval[at(j, s)] = pp_val[at(j, s)];
+            dval[at(j, s)] = s_dval[at(i, s)];
             new_commit[j] = 1;
+            break;
           }
-        }
-
-      // P6 decide gossip. Snapshot committed post-P5.
-      s_comm = committed; s_dval = dval;
-      for (uint32_t j = 0; j < N; ++j)
-        for (uint32_t s = 0; s < S; ++s) {
-          if (s_comm[at(j, s)]) continue;
-          for (uint32_t i = 0; i < N; ++i)  // ascending ⇒ lowest id wins
-            if (honest(i) && s_comm[at(i, s)] && del(r, i, j)) {
-              committed[at(j, s)] = 1;
-              dval[at(j, s)] = s_dval[at(i, s)];
-              new_commit[j] = 1;
-              break;
-            }
-        }
-
-      // P7 timer.
-      for (uint32_t j = 0; j < N; ++j) {
-        if (new_commit[j]) timer[j] = 0;
-        else if (!reset[j]) timer[j] += 1;
       }
+
+    // P7 timer.
+    for (uint32_t j = 0; j < N; ++j) {
+      if (new_commit[j]) timer[j] = 0;
+      else if (!reset[j]) timer[j] += 1;
+    }
+  }
+
+  // One SPEC §6b round in per-(slot, side) aggregates — O(N·S·log N)
+  // instead of the direct definition's O(N²·S). Under broadcast-atomic
+  // faults a receiver's delivered-sender multiset depends only on its
+  // partition side, so P1's order statistics, P4/P5's value-matched
+  // tallies and P6's lowest-id decider all collapse to per-side
+  // aggregates; per-round equivocation stances (SPEC §6b item 3) make
+  // byz support value- and slot-independent. This is what lets the
+  // oracle run pbft-100k-bcast at its benchmark shape (docs/PERF.md
+  // "oracle asymptotics"); DELIVERY_DENSE forces round_direct, the
+  // independent derivation the differential tests compare against.
+  void round_bcast_fast(uint32_t r) {
+    const uint32_t Q = 2 * f + 1, K = f + 1;
+    const bool part = bnet.part_active;
+    const uint32_t n_sides = part ? 2 : 1;
+    auto side_of = [&](uint32_t i) -> uint32_t {
+      return part ? bnet.side[i] : 0;
+    };
+    std::vector<uint8_t> reset(N, 0), new_commit(N, 0);
+
+    // P0 churn.
+    if (churn_fires(seed, r, churn_cut))
+      for (uint32_t i = 0; i < N; ++i) {
+        view[i] += 1; timer[i] = 0; reset[i] = 1;
+      }
+
+    // P1 view catch-up. Per side: the K-th and (K-1)-th largest sender
+    // views, -1-padded to K entries (views are >= 0, so the pads encode
+    // the |views_in| >= f+1 rule). Receiver-side insertion is a clamp:
+    // inserting own view x into a multiset whose K-th/(K-1)-th largest
+    // are a1/a2 puts the new K-th largest at clip(x, a1, a2); a receiver
+    // that IS a sender replaces its own copy, leaving the multiset
+    // unchanged — so its vth is a1 directly.
+    {
+      std::vector<int64_t> vb[2];
+      for (uint32_t i = 0; i < N; ++i)
+        if (honest(i) && bnet.bcast[i])
+          vb[side_of(i)].push_back(int64_t(view[i]));
+      int64_t a1[2] = {0, 0}, a2[2] = {0, 0};
+      for (uint32_t b = 0; b < n_sides; ++b) {
+        std::vector<int64_t>& v = vb[b];
+        while (v.size() < K) v.push_back(-1);
+        std::partial_sort(v.begin(), v.begin() + K, v.end(),
+                          std::greater<int64_t>());
+        a1[b] = v[K - 1];
+        a2[b] = K >= 2 ? v[K - 2] : std::numeric_limits<int64_t>::max();
+      }
+      for (uint32_t j = 0; j < N; ++j) {
+        const uint32_t b = side_of(j);
+        const int64_t x = int64_t(view[j]);
+        const bool in_set = honest(j) && bnet.bcast[j];
+        const int64_t vth =
+            in_set ? a1[b] : std::min(std::max(x, a1[b]), a2[b]);
+        if (vth > x) { view[j] = uint32_t(vth); timer[j] = 0; reset[j] = 1; }
+      }
+    }
+
+    // P2 timeout.
+    for (uint32_t j = 0; j < N; ++j)
+      if (timer[j] >= view_timeout) {
+        view[j] += 1; timer[j] = 0; reset[j] = 1;
+      }
+
+    // P3 pre-prepare (shared).
+    phase_preprepare(r);
+
+    // Per-round equivocation support: one count per side minus the
+    // receiver's own stance (self never travels) — value-independent
+    // under §6b, so it is computed once per round, not per slot.
+    uint32_t eqb[2] = {0, 0};
+    std::vector<uint8_t> eq_send;
+    if (equiv && n_byz > 0) {
+      eq_send.assign(N, 0);
+      for (uint32_t i = 0; i < N; ++i)
+        if (!honest(i) && bnet.bcast[i] && stance(r, i)) {
+          eq_send[i] = 1;
+          ++eqb[side_of(i)];
+        }
+    }
+
+    // P4 + P5 per slot in value-sorted runs: every node rides one sort
+    // of the slot's pp_val column, so a receiver's equal-value sender
+    // class is exactly its run, and a per-(run, side) count of valid
+    // broadcasting senders answers the tally for all receivers at once.
+    // pp_val/pp_seen don't change during P4/P5, so both phases reuse
+    // the one sort; P5's validity (prepared post-P4) is read after the
+    // slot's P4 pass completes — slots are independent, matching the
+    // direct round's whole-array snapshots.
+    std::vector<uint32_t> ord(N), run_of(N), cnt;
+    for (uint32_t s = 0; s < S; ++s) {
+      for (uint32_t i = 0; i < N; ++i) ord[i] = i;
+      std::sort(ord.begin(), ord.end(), [&](uint32_t a, uint32_t b) {
+        return pp_val[at(a, s)] < pp_val[at(b, s)];
+      });
+      uint32_t nruns = 0;
+      for (uint32_t k = 0; k < N; ++k) {
+        if (k > 0 && pp_val[at(ord[k], s)] != pp_val[at(ord[k - 1], s)])
+          ++nruns;
+        run_of[ord[k]] = nruns;
+      }
+      ++nruns;
+      const auto tally = [&](const std::vector<uint8_t>& relevant) {
+        cnt.assign(size_t(nruns) * n_sides, 0);
+        for (uint32_t i = 0; i < N; ++i)
+          if (honest(i) && bnet.bcast[i] && relevant[at(i, s)])
+            ++cnt[size_t(run_of[i]) * n_sides + side_of(i)];
+      };
+      const auto count_for = [&](uint32_t j) -> uint32_t {
+        uint32_t c = cnt[size_t(run_of[j]) * n_sides + side_of(j)];
+        if (honest(j) && !bnet.bcast[j]) ++c;  // self vote never travels
+        if (equiv && n_byz > 0) c += eqb[side_of(j)] - eq_send[j];
+        return c;
+      };
+      // P4 prepare tally (value-matched, incl. self).
+      tally(pp_seen);
+      for (uint32_t j = 0; j < N; ++j) {
+        if (!pp_seen[at(j, s)] || prepared[at(j, s)]) continue;
+        if (count_for(j) >= Q) prepared[at(j, s)] = 1;
+      }
+      // P5 commit tally over post-P4 prepared.
+      tally(prepared);
+      for (uint32_t j = 0; j < N; ++j) {
+        if (!prepared[at(j, s)] || committed[at(j, s)]) continue;
+        if (count_for(j) >= Q) {
+          committed[at(j, s)] = 1;
+          dval[at(j, s)] = pp_val[at(j, s)];
+          new_commit[j] = 1;
+        }
+      }
+      // P6 decide gossip: lowest-id broadcasting honest decider per
+      // (slot, side), fixed BEFORE any adoption (adopters are
+      // uncommitted, so they can never be a decider this round).
+      uint32_t imin[2] = {N, N};
+      uint32_t unset = n_sides;  // early exit once every LIVE side is set
+      for (uint32_t i = 0; i < N && unset; ++i) {
+        if (!honest(i) || !bnet.bcast[i] || !committed[at(i, s)]) continue;
+        const uint32_t b = side_of(i);
+        if (imin[b] == N) { imin[b] = i; --unset; }  // ascending ⇒ lowest id
+      }
+      for (uint32_t j = 0; j < N; ++j) {
+        if (committed[at(j, s)]) continue;
+        const uint32_t b = side_of(j);
+        if (imin[b] == N) continue;
+        committed[at(j, s)] = 1;
+        dval[at(j, s)] = dval[at(imin[b], s)];
+        new_commit[j] = 1;
+      }
+    }
+
+    // P7 timer.
+    for (uint32_t j = 0; j < N; ++j) {
+      if (new_commit[j]) timer[j] = 0;
+      else if (!reset[j]) timer[j] += 1;
     }
   }
 };
@@ -775,6 +1017,16 @@ struct PaxosSim {
   uint64_t seed;
   uint32_t N, R, S, P;
   uint32_t drop_cut, part_cut, churn_cut;
+  uint32_t delivery = DELIVERY_AUTO;
+
+  // Auto: the round only ever queries proposer↔acceptor edges — ~7·P·N
+  // mixer evals edge-wise vs N² materialized — so the crossover sits at
+  // P ≈ N/7: a capped proposer set (the SPEC §5 analog of the Raft cap)
+  // goes edge-wise, the all-propose default stays dense.
+  bool edge_net() const {
+    if (delivery == DELIVERY_AUTO) return 7ull * P < N;
+    return delivery == DELIVERY_EDGE;
+  }
 
   std::vector<uint32_t> promised, acc_bal, acc_val, learned_val;  // [N*S]
   std::vector<uint8_t> learned_mask;                              // [N*S]
@@ -799,7 +1051,7 @@ struct PaxosSim {
     touched.reserve(P);
 
     for (uint32_t r = 0; r < R; ++r) {
-      net.begin_round(seed, N, r, drop_cut, part_cut);
+      net.begin_round(seed, N, r, drop_cut, part_cut, edge_net());
       const bool churn = churn_fires(seed, r, churn_cut);
       for (uint32_t p = 0; p < P; ++p) {
         slot[p] = random_u32(seed, STREAM_VALUE, r, 1, p) % S;
@@ -986,7 +1238,8 @@ class RaftEngine final : public Engine {
  public:
   const char* name() const override { return "raft"; }
   int run(const SimConfig& c) override {
-    if (c.n_nodes == 0 || c.t_max <= c.t_min || c.max_active > c.n_nodes)
+    if (c.n_nodes == 0 || c.t_max <= c.t_min || c.max_active > c.n_nodes ||
+        c.oracle_delivery > DELIVERY_EDGE)
       return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.L = c.log_capacity; sim_.E = c.max_entries;
@@ -995,6 +1248,7 @@ class RaftEngine final : public Engine {
     sim_.churn_cut = c.churn_cut;
     sim_.A = c.max_active;
     sim_.n_byz = c.n_byzantine; sim_.byz_equiv = c.byz_equivocate;
+    sim_.delivery = c.oracle_delivery;
     sim_.run();
     return 0;
   }
@@ -1042,7 +1296,9 @@ class PbftEngine final : public SlotEngine<PbftSim> {
  public:
   const char* name() const override { return "pbft"; }
   int run(const SimConfig& c) override {
-    if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f) return 1;
+    if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f ||
+        c.oracle_delivery > DELIVERY_EDGE)
+      return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity; sim_.f = c.f;
     sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
@@ -1050,6 +1306,7 @@ class PbftEngine final : public SlotEngine<PbftSim> {
     sim_.fault_bcast = c.fault_bcast;
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
+    sim_.delivery = c.oracle_delivery;
     sim_.run();
     return 0;
   }
@@ -1064,12 +1321,15 @@ class PaxosEngine final : public SlotEngine<PaxosSim> {
  public:
   const char* name() const override { return "paxos"; }
   int run(const SimConfig& c) override {
-    if (c.n_nodes == 0 || c.log_capacity == 0) return 1;
+    if (c.n_nodes == 0 || c.log_capacity == 0 ||
+        c.oracle_delivery > DELIVERY_EDGE)
+      return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity;
     sim_.P = c.n_proposers ? c.n_proposers : c.n_nodes;
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
+    sim_.delivery = c.oracle_delivery;
     sim_.run();
     return 0;
   }
@@ -1143,13 +1403,14 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t max_active,     // 0 = dense; >0 = SPEC §3b cap
                   uint32_t n_byzantine,    // SPEC §3c minority size
                   uint32_t byz_equivocate, // 0 silent, 1 double-grant
+                  uint32_t oracle_delivery,  // 0 auto, 1 dense, 2 edge
                   uint32_t* out_commit,    // [N]
                   uint32_t* out_log_term,  // [N*L]
                   uint32_t* out_log_val,   // [N*L]
                   uint32_t* out_term,      // [N]
                   uint32_t* out_role) {    // [N]
   if (n_nodes == 0 || t_max <= t_min || max_active > n_nodes ||
-      n_byzantine > n_nodes)
+      n_byzantine > n_nodes || oracle_delivery > 2)
     return 1;
   ctpu::RaftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
@@ -1157,6 +1418,7 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.A = max_active;
   sim.n_byz = n_byzantine; sim.byz_equiv = byz_equivocate;
+  sim.delivery = oracle_delivery;
   sim.run();
   std::memcpy(out_commit, sim.commit.data(), sizeof(uint32_t) * n_nodes);
   std::memcpy(out_log_term, sim.log_term.data(),
@@ -1173,16 +1435,19 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t n_byzantine, uint32_t byz_equivocate,
                   uint32_t fault_bcast,     // SPEC §6b broadcast faults
                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
+                  uint32_t oracle_delivery,  // 0 auto, 1 dense, 2 edge
                   uint8_t* out_committed,   // [N*S]
                   uint32_t* out_dval,       // [N*S]
                   uint32_t* out_view) {     // [N]
-  if (n_nodes != 3 * f + 1 || n_byzantine > f) return 1;
+  if (n_nodes != 3 * f + 1 || n_byzantine > f || oracle_delivery > 2)
+    return 1;
   ctpu::PbftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
   sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
   sim.equiv = byz_equivocate;
   sim.fault_bcast = fault_bcast;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.delivery = oracle_delivery;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_committed, sim.committed.data(), ns);
@@ -1194,16 +1459,18 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
 int ctpu_paxos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                    uint32_t n_slots, uint32_t n_proposers,
                    uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
+                   uint32_t oracle_delivery,    // 0 auto, 1 dense, 2 edge
                    uint32_t* out_learned_val,   // [N*S]
                    uint8_t* out_learned_mask,   // [N*S]
                    uint32_t* out_promised,      // [N*S]
                    uint32_t* out_acc_bal,       // [N*S]
                    uint32_t* out_acc_val) {     // [N*S]
-  if (n_nodes == 0 || n_slots == 0) return 1;
+  if (n_nodes == 0 || n_slots == 0 || oracle_delivery > 2) return 1;
   ctpu::PaxosSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
   sim.P = n_proposers ? n_proposers : n_nodes;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.delivery = oracle_delivery;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_learned_val, sim.learned_val.data(), sizeof(uint32_t) * ns);

@@ -16,14 +16,14 @@
 extern "C" {
 int ctpu_raft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint32_t*, uint32_t*, uint32_t*, uint32_t*,
-                  uint32_t*);
+                  uint32_t, uint32_t, uint32_t*, uint32_t*, uint32_t*,
+                  uint32_t*, uint32_t*);
 int ctpu_pbft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint8_t*, uint32_t*, uint32_t*);
+                  uint32_t, uint8_t*, uint32_t*, uint32_t*);
 int ctpu_paxos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                   uint32_t, uint32_t, uint32_t*, uint8_t*, uint32_t*,
-                   uint32_t*, uint32_t*);
+                   uint32_t, uint32_t, uint32_t, uint32_t*, uint8_t*,
+                   uint32_t*, uint32_t*, uint32_t*);
 int ctpu_dpos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t*, uint32_t*,
                   uint32_t*, int32_t*);
@@ -54,6 +54,23 @@ int run_twice(const char* name, size_t out_words, F&& f) {
   return 0;
 }
 
+// The delivery-strategy contract (oracle.cpp Net): DENSE (1) and EDGE
+// (2) evaluate the same pure draw function, so outputs must match
+// byte-for-byte. ``f`` takes (out, oracle_delivery).
+template <typename F>
+int run_match(const char* name, size_t out_words, F&& f) {
+  std::vector<uint32_t> a(out_words, 0xDEADBEEFu), b(out_words, 0x12345678u);
+  if (f(a.data(), 1u) != 0) return fail(name);
+  if (f(b.data(), 2u) != 0) return fail(name);
+  if (std::memcmp(a.data(), b.data(), out_words * 4) != 0) {
+    std::fprintf(stderr, "selftest: %s dense/edge delivery diverge\n", name);
+    return 1;
+  }
+  std::printf("selftest: %-6s ok (dense == edge, %zu words)\n", name,
+              out_words);
+  return 0;
+}
+
 }  // namespace
 
 int main() {
@@ -63,27 +80,40 @@ int main() {
     size_t W = N + 2 * size_t(N) * L + N + N;
     rc |= run_twice("raft", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
-                           o, o + N, o + N + size_t(N) * L,
+                           0, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // Capped engine (SPEC §3b): same shapes, max_active = 3.
     rc |= run_twice("raft-capped", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
-                           o, o + N, o + N + size_t(N) * L,
+                           0, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // SPEC §3c adversaries: withholding and double-granting minorities.
     rc |= run_twice("raft-byz-silent", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 2, 0,
-                           o, o + N, o + N + size_t(N) * L,
+                           0, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     rc |= run_twice("raft-byz-equiv", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 2, 1,
-                           o, o + N, o + N + size_t(N) * L,
+                           0, o, o + N, o + N + size_t(N) * L,
+                           o + N + 2 * size_t(N) * L,
+                           o + 2 * N + 2 * size_t(N) * L);
+    });
+    // Edge-wise vs dense delivery: byte-identical on both engines.
+    rc |= run_match("raft-delivery", W, [&](uint32_t* o, uint32_t d) {
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
+                           d, o, o + N, o + N + size_t(N) * L,
+                           o + N + 2 * size_t(N) * L,
+                           o + 2 * N + 2 * size_t(N) * L);
+    });
+    rc |= run_match("raft-capped-delivery", W, [&](uint32_t* o, uint32_t d) {
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
+                           d, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
@@ -94,18 +124,31 @@ int main() {
     // committed (u8, round up to words) + dval + view
     size_t W = (ns + 3) / 4 + ns + N;
     rc |= run_twice("pbft", W, [&](uint32_t* o) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, 0, DROP, PART, CHURN,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, 0, DROP, PART, CHURN, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     rc |= run_twice("pbft-equiv", W, [&](uint32_t* o) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // SPEC §6b broadcast-atomic fault model, with equivocation.
     rc |= run_twice("pbft-bcast", W, [&](uint32_t* o) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, 0,
+                           reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
+                           o + (ns + 3) / 4 + ns);
+    });
+    // §6 edge model: dense vs forced edge-wise delivery queries.
+    rc |= run_match("pbft-delivery", W, [&](uint32_t* o, uint32_t d) {
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, d,
+                           reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
+                           o + (ns + 3) / 4 + ns);
+    });
+    // §6b: the per-(slot, side) aggregate round (auto/edge) vs the
+    // direct per-receiver definition (forced dense).
+    rc |= run_match("pbft-bcast-agg", W, [&](uint32_t* o, uint32_t d) {
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -115,7 +158,12 @@ int main() {
     size_t ns = size_t(N) * S;
     size_t W = ns + (ns + 3) / 4 + 3 * ns;
     rc |= run_twice("paxos", W, [&](uint32_t* o) {
-      return ctpu_paxos_run(55, N, R, S, 0, DROP, PART, CHURN, o,
+      return ctpu_paxos_run(55, N, R, S, 0, DROP, PART, CHURN, 0, o,
+                            reinterpret_cast<uint8_t*>(o + ns), o + ns + (ns + 3) / 4,
+                            o + ns + (ns + 3) / 4 + ns, o + ns + (ns + 3) / 4 + 2 * ns);
+    });
+    rc |= run_match("paxos-delivery", W, [&](uint32_t* o, uint32_t d) {
+      return ctpu_paxos_run(55, N, R, S, 2, DROP, PART, CHURN, d, o,
                             reinterpret_cast<uint8_t*>(o + ns), o + ns + (ns + 3) / 4,
                             o + ns + (ns + 3) / 4 + ns, o + ns + (ns + 3) / 4 + 2 * ns);
     });

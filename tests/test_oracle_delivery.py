@@ -1,0 +1,88 @@
+"""Edge-wise vs dense oracle delivery — byte-identical digests.
+
+The oracle's ``Net`` (cpp/oracle.cpp) answers SPEC §2 delivery queries in
+one of two execution strategies: DENSE materializes the [N, N] matrix
+once per round (the historic design), EDGE evaluates the counter-based
+draw per live edge on demand — O(A·N) per capped round, which is what
+makes 100k-node configs oracle-tractable (docs/PERF.md "oracle
+asymptotics"). Both evaluate the same pure function of (seed, r, i, j),
+so forcing either strategy must not move a single byte of any decided
+log. These tests pin that per engine at N ≤ 2k (where dense is still
+cheap); the ≥50k-node pairing against the TPU engine lives in
+tests/test_oracle_benchscale.py, and cpp/oracle_selftest.cpp
+(``run_match``) repeats the check under ASan+UBSan.
+
+For pbft-bcast the knob switches MORE than the Net: auto/edge run the
+per-(slot, side) aggregate §6b round, dense the direct per-receiver
+definition — so digest equality here cross-checks two independent
+derivations of SPEC §6b, not just two delivery-query paths.
+"""
+import pytest
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.network import simulator
+
+ADV = dict(drop_rate=0.08, partition_rate=0.15, churn_rate=0.05)
+
+CONFIGS = {
+    # Dense SPEC §3 raft: every pair queried — edge mode recomputes draws.
+    "raft-dense": Config(protocol="raft", engine="cpu", n_nodes=96,
+                         n_rounds=48, log_capacity=32, max_entries=24,
+                         seed=11, **ADV),
+    # SPEC §3b capped raft at the old oracle ceiling (auto → edge-wise).
+    "raft-capped": Config(protocol="raft", engine="cpu", n_nodes=2048,
+                          n_rounds=24, log_capacity=32, max_entries=24,
+                          max_active=8, seed=12, **ADV),
+    # §3c byzantine tallies query (j, c) back-edges too.
+    "raft-capped-byz": Config(protocol="raft", engine="cpu", n_nodes=512,
+                              n_rounds=32, log_capacity=32, max_entries=24,
+                              max_active=6, n_byzantine=64,
+                              byz_mode="equivocate", seed=13, **ADV),
+    # Dense SPEC §6 pbft (edge fault model) with equivocation.
+    "pbft-edge": Config(protocol="pbft", engine="cpu", f=10, n_nodes=31,
+                        n_rounds=24, log_capacity=8, n_byzantine=3,
+                        byz_mode="equivocate", seed=14, **ADV),
+    # SPEC §6b: aggregate round (auto/edge) vs direct definition (dense).
+    "pbft-bcast": Config(protocol="pbft", engine="cpu", fault_model="bcast",
+                         f=167, n_nodes=502, n_rounds=24, log_capacity=8,
+                         n_byzantine=41, byz_mode="equivocate", seed=15,
+                         **ADV),
+    # All-propose paxos (P == N: auto stays dense; edge is forced here).
+    "paxos": Config(protocol="paxos", engine="cpu", n_nodes=600, n_rounds=12,
+                    log_capacity=64, seed=16, **ADV),
+    # Capped proposers (7·P < N: auto goes edge-wise; dense is forced).
+    "paxos-capped": Config(protocol="paxos", engine="cpu", n_nodes=2000,
+                           n_rounds=12, log_capacity=64, n_proposers=5,
+                           seed=17, **ADV),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_dense_edge_and_auto_delivery_digests_identical(name):
+    cfg = CONFIGS[name]
+    dense = simulator.run(cfg, oracle_delivery="dense")
+    edge = simulator.run(cfg, oracle_delivery="edge")
+    auto = simulator.run(cfg)  # the per-engine default choice
+    assert dense.digest == edge.digest == auto.digest, name
+    assert dense.payload == edge.payload
+
+
+def test_tpu_engine_rejects_delivery_knob():
+    cfg = Config(protocol="raft", engine="tpu", n_nodes=5, n_rounds=4)
+    with pytest.raises(ValueError, match="oracle_delivery"):
+        simulator.run(cfg, warmup=False, oracle_delivery="edge")
+
+
+def test_dpos_rejects_delivery_knob():
+    # DPoS's oracle has no [N, N] delivery layer (one producer row per
+    # round is already edge-wise) — the knob would be a silent no-op.
+    cfg = Config(protocol="dpos", engine="cpu", n_nodes=32, n_rounds=16,
+                 log_capacity=16, n_candidates=8, n_producers=3)
+    with pytest.raises(ValueError, match="dpos"):
+        simulator.run(cfg, oracle_delivery="edge")
+
+
+def test_unknown_delivery_rejected():
+    cfg = Config(protocol="raft", engine="cpu", n_nodes=8, n_rounds=4)
+    with pytest.raises(ValueError, match="unknown oracle delivery"):
+        simulator.run(cfg, oracle_delivery="sparse")
