@@ -1,0 +1,27 @@
+# Repo-level entry points. The whole gate is ONE command:
+#
+#   make check     # consensus-lint + ruff + mypy + clang-tidy + tier-1
+#
+# (tools/check.py gates ruff/mypy/clang-tidy on availability and prints
+# a per-layer summary; see docs/STATIC_ANALYSIS.md.)
+
+PY ?= python
+
+check:
+	$(PY) tools/check.py
+
+lint:
+	$(PY) -m tools.lint
+
+tidy:
+	$(MAKE) -C cpp tidy
+
+san-test:
+	$(MAKE) -C cpp san-test
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly
+
+.PHONY: check lint tidy san-test test

@@ -100,6 +100,49 @@ ORACLE_ONE_REPEAT = {"raft-100k", "pbft-100k-bcast", "paxos-10kx10k",
 # dispatch scanning over repeat lanes instead (time_tpu_repeat_scan).
 REPEAT_SCAN = {"raft-5node"}
 
+# HBM bandwidth of the chip the committed rows ran on (TPU v5 lite /
+# v5e: 819 GB/s per chip) — the denominator that turns steps/sec into a
+# %-of-peak figure a perf claim can be judged against (docs/PERF.md
+# §"Achieved bandwidth").
+HBM_PEAK_GBPS = 819.0
+
+
+def carry_nbytes(cfg: Config) -> int:
+    """Byte size of the batched scan carry, from the engine's state
+    schema via jax.eval_shape — no buffer is ever allocated, so this is
+    safe to run for 100k-node configs on any host."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensus_tpu.network import simulator
+    eng = simulator.engine_def(cfg)
+    tpl = jax.eval_shape(
+        lambda s: jax.vmap(lambda x: eng.make_carry(cfg, x))(s),
+        jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32))
+    return sum(int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+               for leaf in jax.tree.leaves(tpl))
+
+
+def bandwidth_stats(cfg: Config, wall_s: float) -> dict:
+    """Achieved-bandwidth floor for a timed row (docs/PERF.md formula):
+    every round re-reads and re-writes the persistent carry, so
+    bytes-touched/round >= 2*carry_bytes and
+
+        achieved >= 2 * carry_bytes * n_rounds / wall_s.
+
+    A FLOOR: round temporaries, multi-pass sorts and collective traffic
+    only add bytes, so hbm_peak_frac understates how bandwidth-bound a
+    config is — useful as a denominator, never as a brag."""
+    nbytes = carry_nbytes(cfg)
+    achieved = 2.0 * nbytes * cfg.n_rounds / wall_s if wall_s > 0 else 0.0
+    return {"carry_bytes": nbytes,
+            "bytes_per_round_floor": 2 * nbytes,
+            "achieved_gbps_floor": round(achieved / 1e9, 3),
+            "hbm_peak_frac_floor": round(achieved / (HBM_PEAK_GBPS * 1e9),
+                                         4),
+            "hbm_peak_gbps": HBM_PEAK_GBPS}
+
 
 def time_tpu(cfg: Config, repeats: int = 3) -> dict:
     """Time the round loop on device. runner.run_device's completion
@@ -148,6 +191,7 @@ def time_tpu(cfg: Config, repeats: int = 3) -> dict:
     steps = cfg.n_sweeps * cfg.n_nodes * cfg.n_rounds
     return {"engine": "tpu", "config": json.loads(cfg.to_json()),
             "steps": steps, "wall_s": best, "steps_per_sec": steps / best,
+            "bandwidth": bandwidth_stats(cfg, best),
             "digest": serialize.digest(payload),
             "metrics": metrics_snap}
 
@@ -216,6 +260,7 @@ def time_tpu_repeat_scan(cfg: Config, repeats: int = 8) -> dict:
             "timing": "repeat-scan-one-dispatch",
             "repeats_in_dispatch": repeats,
             "dispatch_wall_s": dispatch_wall,
+            "bandwidth": bandwidth_stats(cfg, wall),
             "digest": serialize.digest(payload),
             "metrics": metrics_snap}
 
@@ -285,10 +330,33 @@ def _progress(row: dict) -> None:
           file=sys.stderr, flush=True)
 
 
+def backfill_bandwidth(path: pathlib.Path) -> int:
+    """Add the achieved-bandwidth column to existing RESULTS rows from
+    their recorded config + wall (pure arithmetic over the state schema
+    — no device run, so committed on-chip walls keep their provenance).
+    Returns the number of rows updated."""
+    doc = json.loads(path.read_text())
+    n = 0
+    for row in doc.get("rows", []):
+        tpu = row.get("tpu")
+        if not tpu or "wall_s" not in tpu or "config" not in tpu:
+            continue  # oracle-only rows and the padded f-sweep program
+        cfg = Config.from_json(json.dumps(tpu["config"]))
+        tpu["bandwidth"] = bandwidth_stats(cfg, tpu["wall_s"])
+        n += 1
+    path.write_text(json.dumps(doc, indent=2))
+    return n
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small pbft ladder, fewer repeats")
+    ap.add_argument("--backfill-bandwidth", action="store_true",
+                    help="no benchmark runs: recompute the bandwidth "
+                         "column for every TPU row already in the output "
+                         "JSON (state-schema arithmetic over recorded "
+                         "walls) and rewrite the file")
     ap.add_argument("--skip-oracle", action="store_true")
     ap.add_argument("--skip-tpu", action="store_true",
                     help="oracle baseline only (no JAX engine runs) — used "
@@ -303,6 +371,14 @@ def main() -> None:
                     help="JAX backend for the engine rows (hang-proof "
                          "probe; see consensus_tpu.utils.platform)")
     args = ap.parse_args()
+
+    if args.backfill_bandwidth:
+        path = pathlib.Path(args.out) if args.out else \
+            pathlib.Path(__file__).parent / "RESULTS.json"
+        n = backfill_bandwidth(path)
+        print(f"bandwidth column backfilled on {n} rows in {path}",
+              file=sys.stderr)
+        return
 
     if args.skip_tpu:
         results = {"device": "none (oracle only)", "platform": "cpu-oracle",

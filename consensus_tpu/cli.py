@@ -51,6 +51,19 @@ _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
                "partition_rate": float, "churn_rate": float,
                "crash_prob": float, "recover_prob": float}
 
+# Config fields with NO native-CLI flag (cpp/consensus_sim.cpp): TPU-
+# engine execution/adversary knobs. The native front door still reaches
+# them for --engine tpu because it re-execs `python3 -m consensus_tpu`
+# BEFORE strict flag parsing; for --engine cpu they are rejected (here
+# or by Config validation — crash_prob is a §6c tpu-only adversary)
+# rather than silently ignored. Machine-checked against both flag
+# surfaces by tools/lint (check `cli`): removing an entry demands a
+# native flag, adding one demands the field really has none.
+NATIVE_CLI_TPU_ONLY = frozenset({
+    "mesh_shape", "scan_chunk", "sweep_chunk",
+    "crash_prob", "recover_prob", "max_crashed",
+})
+
 
 def build_parser() -> argparse.ArgumentParser:
     # Config-field flags default to SUPPRESS so args_to_config can tell

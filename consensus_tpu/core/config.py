@@ -79,7 +79,7 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     epoch_len: int = 16          # rounds per epoch
 
     # Parallelism (TPU engine only; ignored by the oracle).
-    mesh_shape: tuple = ()       # e.g. (8,) to shard sweeps/nodes over 8 chips
+    mesh_shape: tuple[int, ...] = ()  # e.g. (8,): sweeps/nodes over 8 chips
     scan_chunk: int = 0          # 0 ⇒ single scan; else blocked scan chunk size
     # 0 ⇒ all sweeps batch into one XLA program; else the host runs
     # groups of at most this many sweeps as separate programs and

@@ -41,7 +41,8 @@ _KS_PARITY = np.uint32(0x1BD11BDA)
 _ROT_A = (13, 15, 26, 6)
 _ROT_B = (17, 29, 16, 24)
 
-# Stream constants. Arbitrary odd 32-bit values; must match cpp/oracle.cpp.
+# Stream constants. Arbitrary odd 32-bit values; must match cpp/threefry.h
+# (machine-checked by tools/lint, check `streams`).
 STREAM_DELIVER = np.uint32(0x9E3779B1)  # per (round, edge) message delivery
 STREAM_TIMEOUT = np.uint32(0x85EBCA77)  # per (term, node) election timeout
 STREAM_CHURN = np.uint32(0xC2B2AE3D)    # per round leader-churn event
@@ -49,11 +50,46 @@ STREAM_PARTITION = np.uint32(0x27D4EB2F)  # per round partition side/active
 STREAM_STAKE = np.uint32(0x165667B1)    # per validator initial stake (DPoS)
 STREAM_VOTE = np.uint32(0xD3A2646C)     # per (epoch, validator) vote target
 STREAM_VALUE = np.uint32(0xFD7046C5)    # proposal payload values
-STREAM_BYZANTINE = np.uint32(0xB55A4F09)  # per-config byzantine node pick
+STREAM_BYZANTINE = np.uint32(0xB55A4F09)  # reserved: byzantine node pick
 STREAM_EQUIV = np.uint32(0x94D049BB)    # per (round, byz sender, receiver) stance
 # SPEC §6c crash-recover adversary. TPU-engine only (not mirrored in
 # cpp/oracle.cpp; Config rejects crash_prob > 0 on the cpu engine).
 STREAM_CRASH = np.uint32(0x68E31DA5)    # per (round, node) crash/recover draw
+
+# --- machine-checked stream registry (tools/lint, check `streams`) ---------
+#
+# For each stream: what each of the three absorb slots (ctx, c0, c1) of
+# `random_u32(seed^stream, ctx, c0, c1)` keys. `None` means the slot is
+# PINNED — every call site must pass a literal constant there, because
+# varying a pinned slot reuses counter space another draw owns and
+# silently correlates independent adversary events. "subdraw" slots are
+# literal sub-stream selectors (e.g. STREAM_CRASH c0: 0 = crash draw,
+# 1 = recover draw). Adding a stream = add the constant above, its
+# entry here, and the cpp/threefry.h mirror (or STREAM_TPU_ONLY);
+# docs/STATIC_ANALYSIS.md walks through it.
+STREAM_KEYS = {
+    "STREAM_DELIVER": ("round", "src", "dst"),        # via the §2 mixer
+    "STREAM_TIMEOUT": ("term", None, "node"),
+    "STREAM_CHURN": ("round", None, None),
+    "STREAM_PARTITION": ("round", "subdraw", "node"),  # c0: 0=active 1=side
+    "STREAM_STAKE": (None, None, "validator"),
+    "STREAM_VOTE": ("epoch", None, "validator"),
+    "STREAM_VALUE": ("round_or_view", "subdraw", "node_or_slot"),
+    "STREAM_BYZANTINE": ("reserved", "reserved", "reserved"),
+    "STREAM_EQUIV": ("round", "sender", "receiver"),
+    "STREAM_CRASH": ("round", "subdraw", "node"),      # c0: 0=crash 1=recover
+}
+
+# Streams the C++ oracle deliberately does NOT mirror (cpp/threefry.h):
+# SPEC §6c is TPU-engine-only — Config rejects crash_prob > 0 on the
+# cpu engine rather than silently simulating different trajectories.
+STREAM_TPU_ONLY = frozenset({"STREAM_CRASH"})
+
+# Streams drawn through the SPEC §2 murmur-style mixer (delivery_u32_*),
+# never through the threefry entry points — the two generators share a
+# key constant but not counter space, so a threefry draw keyed on a
+# mixer stream would be a new, unregistered stream in disguise.
+STREAM_MIXER_ONLY = frozenset({"STREAM_DELIVER"})
 
 
 def _rotl32_np(x: np.ndarray, r: int) -> np.ndarray:

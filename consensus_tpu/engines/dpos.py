@@ -29,6 +29,19 @@ class DposState(NamedTuple):
     down: jnp.ndarray       # [V] bool — SPEC §6c crashed mask
 
 
+# SPEC §6c persistent/volatile carry split (tools/lint check `registry`):
+# the chain is durable and dpos carries NO volatile per-node state — a
+# down validator simply stops appending (the round masks `append` with
+# the down flags), so there is no recovery reset and no freeze call.
+CRASH_SPLIT = {
+    "seed": "meta",
+    "chain_r": "persistent",
+    "chain_p": "persistent",
+    "chain_len": "persistent",
+    "down": "meta",
+}
+
+
 def dpos_schedule(cfg: Config, seed):
     """Per-epoch stakes → votes → tally → top-K producers (SPEC §7)."""
     V, C, K = cfg.n_nodes, cfg.n_candidates, cfg.n_producers

@@ -39,6 +39,22 @@ class PaxosState(NamedTuple):
     down: jnp.ndarray          # [N] bool — SPEC §6c crashed mask
 
 
+# SPEC §6c persistent/volatile carry split (tools/lint check `registry`):
+# promised[] is volatile — safe because ballots r·N+p+1 strictly
+# increase across rounds, so no later prepare can be outbid by a
+# forgotten promise (SPEC §6c); acc_bal/acc_val (the accepted-value
+# history Paxos safety rests on) and the learner state persist.
+CRASH_SPLIT = {
+    "seed": "meta",
+    "promised": "volatile",
+    "acc_bal": "persistent",
+    "acc_val": "persistent",
+    "learned_val": "persistent",
+    "learned_mask": "persistent",
+    "down": "meta",
+}
+
+
 def paxos_init(cfg: Config, seed) -> PaxosState:
     N, S = cfg.n_nodes, cfg.log_capacity
     z = jnp.zeros((N, S), jnp.int32)

@@ -38,7 +38,27 @@ class PbftState(NamedTuple):
     down: jnp.ndarray       # [N] bool — SPEC §6c crashed mask
 
 
-def _vth_select(w, f, vmax):
+# SPEC §6c persistent/volatile carry split (tools/lint check `registry`):
+# view/timer rejoin at 0 (P1's f+1 catch-up restores the view from live
+# peers); the per-slot message log — pp_*, prepared, committed, dval —
+# is the persisted state PBFT's safety argument rests on. Shared by the
+# §6b bcast engine (same PbftState, same split — engines/pbft_bcast.py
+# declares it independently so the lint checks each round's code).
+CRASH_SPLIT = {
+    "seed": "meta",
+    "view": "volatile",
+    "timer": "volatile",
+    "pp_seen": "persistent",
+    "pp_view": "persistent",
+    "pp_val": "persistent",
+    "prepared": "persistent",
+    "committed": "persistent",
+    "dval": "persistent",
+    "down": "meta",
+}
+
+
+def _vth_select(w, f, vmax: int):
     """(f+1)-th largest per column of ``w`` (ints in [-1, vmax]): the
     largest v with |{i : w[i, j] >= v}| >= f+1, by fixed-depth binary
     search on the value range — the full [N, N] column sort it replaces

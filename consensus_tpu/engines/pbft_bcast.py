@@ -49,6 +49,23 @@ from .pbft import PBFT_TELEMETRY, PbftState, pbft_init
 
 I32_MAX = jnp.iinfo(jnp.int32).max
 
+# SPEC §6c persistent/volatile carry split — identical to the dense §6
+# kernel's (engines/pbft.py: the fault granularity changes, the state
+# split does not); declared per-module so tools/lint (check `registry`)
+# verifies THIS round's reset/freeze code.
+CRASH_SPLIT = {
+    "seed": "meta",
+    "view": "volatile",
+    "timer": "volatile",
+    "pp_seen": "persistent",
+    "pp_view": "persistent",
+    "pp_val": "persistent",
+    "prepared": "persistent",
+    "committed": "persistent",
+    "dval": "persistent",
+    "down": "meta",
+}
+
 
 class _SortedTally:
     """Exact multiset counter, entirely in sorted space: count[s, j] =

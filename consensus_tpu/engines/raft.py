@@ -46,6 +46,29 @@ class RaftState(NamedTuple):
     down: jnp.ndarray       # [N] bool — SPEC §6c crashed mask
 
 
+# SPEC §6c persistent/volatile carry split — machine-checked against the
+# recovery-reset and freeze code in raft_round by tools/lint (check
+# `registry`): volatile fields are exactly those reset on the recovery
+# mask; persistent+volatile is exactly the frozen tuple; "meta" fields
+# (the per-sweep seed and the down mask itself) sit outside the split.
+# timeout is persistent because it is a pure function of (seed, term,
+# id) and the term persists — recomputing it on rejoin is a no-op.
+CRASH_SPLIT = {
+    "seed": "meta",
+    "term": "persistent",
+    "role": "volatile",
+    "voted_for": "persistent",
+    "log_term": "persistent",
+    "log_val": "persistent",
+    "log_len": "persistent",
+    "commit": "persistent",
+    "timer": "volatile",
+    "timeout": "persistent",
+    "match_idx": "volatile",     # leader bookkeeping, re-init at election
+    "next_idx": "volatile",
+    "down": "meta",
+}
+
 # Shared kernels live in ops/ (SURVEY.md §7 package layout); the aliases
 # keep this module's call sites terse and preserve the original seams.
 from ..ops.adversary import CRASH_TELEMETRY, crash_counts, crash_transition

@@ -84,6 +84,30 @@ class RaftSparseState(NamedTuple):
     down: jnp.ndarray        # [N] bool — SPEC §6c crashed mask
 
 
+# SPEC §6c persistent/volatile carry split (tools/lint check `registry`;
+# same semantics as the dense kernel's — see engines/raft.py). The
+# tracked-leader slots are "meta": they are not per-node protocol state
+# but a cache keyed by lead_id, whose lifecycle re-initializes rows at
+# (re-)election and never tracks a down node, so recovery resets and
+# the down-freeze both bypass them by construction.
+CRASH_SPLIT = {
+    "seed": "meta",
+    "term": "persistent",
+    "role": "volatile",
+    "voted_for": "persistent",
+    "log_term": "persistent",
+    "log_val": "persistent",
+    "log_len": "persistent",
+    "commit": "persistent",
+    "timer": "volatile",
+    "timeout": "persistent",
+    "lead_id": "meta",
+    "lead_match": "meta",
+    "lead_next": "meta",
+    "down": "meta",
+}
+
+
 def raft_sparse_init(cfg: Config, seed) -> RaftSparseState:
     N, L, A = cfg.n_nodes, cfg.log_capacity, cfg.max_active
     seed = jnp.asarray(seed, jnp.uint32)
@@ -278,7 +302,7 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     log_len = log_len + can_prop.astype(jnp.int32)
     # Tracked leaders' self-match follows their own append.
     self_pos = jnp.where(lvalid & can_prop[lid], lid, N)
-    lead_match = lead_match.at[jnp.arange(A), self_pos].set(
+    lead_match = lead_match.at[jnp.arange(A, dtype=jnp.int32), self_pos].set(
         log_len[lid].astype(mdt), mode="drop")
 
     # ---- P3b snapshot tracked-sender state.
@@ -335,7 +359,8 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     # ---- P3d tracked leaders process acks.
     still_lead_k = was_lead_k & (role[lid] == ROLE_L)
     del_jl = dedge(idx[:, None], jnp.where(was_lead_k, lead_id, NONE)[None, :])
-    ackm = (ack_slot[:, None] == jnp.arange(A)[None, :]) & del_jl  # [N, A]
+    ackm = (ack_slot[:, None] == jnp.arange(A, dtype=jnp.int32)[None, :]) \
+        & del_jl  # [N, A]
     if withhold:
         ackm &= honest[:, None]  # byz acks never travel
     t_in3 = jnp.max(jnp.where(ackm, ack_term[:, None], 0), axis=0)  # [A]

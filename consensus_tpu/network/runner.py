@@ -66,9 +66,9 @@ class EngineDef:
     name: str
     make_carry: Callable[..., Any]
     round_fn: Callable[..., Any]
-    extract: Callable[[Any], dict]
+    extract: Callable[[Any], dict[str, Any]]
     carry_pspec: Callable[[Config], Any]
-    telemetry_names: tuple = ()
+    telemetry_names: tuple[str, ...] = ()
     round_telem: Callable[..., Any] | None = None
 
 
@@ -177,21 +177,21 @@ def _leaf_crc(a) -> int:
     return zlib.crc32(np.ascontiguousarray(a).tobytes())
 
 
-def _manifest_crc(config: dict, next_round: int, seeds: list,
-                  leaf_crc32: list) -> int:
+def _manifest_crc(config: dict, next_round: int, seeds: list[int],
+                  leaf_crc32: list[int]) -> int:
     return zlib.crc32(json.dumps(
         {"config": config, "next_round": next_round, "seeds": seeds,
          "leaf_crc32": leaf_crc32}, sort_keys=True).encode())
 
 
-def rotation_path(path, i: int) -> pathlib.Path:
+def rotation_path(path: str | os.PathLike, i: int) -> pathlib.Path:
     """The i-th rotated snapshot of ``path``: ckpt.npz -> ckpt.{i}.npz
     (i=0 is ``path`` itself)."""
     p = pathlib.Path(path)
     return p if i == 0 else p.with_name(f"{p.stem}.{i}{p.suffix}")
 
 
-def checkpoint_candidates(path) -> list:
+def checkpoint_candidates(path) -> list[pathlib.Path]:
     """Existing snapshot paths for ``path``, newest first.
 
     Tolerates ONE missing rung before stopping: save_checkpoint's
@@ -239,7 +239,7 @@ def _fsync_dir(path) -> None:
         os.close(fd)
 
 
-def _host_arrays(carry) -> dict:
+def _host_arrays(carry) -> dict[str, np.ndarray]:
     """The snapshot PULL step: the batched carry's leaves as contiguous
     host arrays under the format's ``leaf_i`` naming. This is where the
     device→host transfer blocks — the async writer
@@ -312,8 +312,9 @@ def _write_snapshot(path, cfg: Config, arrays: dict, next_round: int,
     return nbytes
 
 
-def save_checkpoint(path, cfg: Config, carry, next_round: int,
-                    seeds=None, keep: int = 1, fsync: bool = False) -> dict:
+def save_checkpoint(path, cfg: Config, carry, next_round: int, seeds=None,
+                    keep: int = 1,
+                    fsync: bool = False) -> dict[str, int | float]:
     """Snapshot the batched carry after ``next_round`` rounds have run,
     synchronously on the calling thread (the async pipeline in
     :mod:`consensus_tpu.network.ckpt_writer` composes the same two steps
@@ -683,7 +684,7 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
     return carry
 
 
-def _empty_io() -> dict:
+def _empty_io() -> dict[str, int | float]:
     # save_s = time the CHUNK LOOP was blocked for checkpointing (the
     # full save wall when sync; enqueue + backpressure + drain waits
     # when async). save_hidden_s = writer-thread time overlapped with
@@ -727,7 +728,7 @@ def _seeds_crc(seeds) -> int:
         np.asarray(seeds, dtype=np.uint32)).tobytes())
 
 
-def write_group_manifest(root, cfg: Config, seeds, completed: list,
+def write_group_manifest(root, cfg: Config, seeds, completed: list[int],
                          n_groups: int) -> None:
     """Atomically record which sweep groups of ``cfg`` have completed.
     ``seeds`` is the FULL per-sweep seed vector (its CRC guards a future
@@ -768,7 +769,8 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         resume: bool = False, stats: dict | None = None,
         seeds=None, keep_checkpoints: int = 2,
         telemetry: bool = False, fsync_checkpoints: bool = False,
-        sync_checkpoints: bool = False, group_dir=None) -> dict:
+        sync_checkpoints: bool = False,
+        group_dir=None) -> dict[str, np.ndarray]:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a

@@ -31,6 +31,7 @@ from __future__ import annotations
 import bisect
 import contextlib
 import threading
+from typing import Any, Iterator
 
 SCHEMA_VERSION = 1
 
@@ -41,7 +42,7 @@ _PAUSED = False
 
 
 @contextlib.contextmanager
-def paused():
+def paused() -> Iterator[None]:
     """Temporarily drop all observations (every registry in-process) —
     used around warmup passes so exported histograms measure the run,
     not jit tracing + XLA compilation (docs/OBSERVABILITY.md)."""
@@ -65,16 +66,16 @@ class Counter:
 
     __slots__ = ("value",)
 
-    def __init__(self):
-        self.value = 0
+    def __init__(self) -> None:
+        self.value: int | float = 0
 
-    def inc(self, v=1) -> None:
+    def inc(self, v: int | float = 1) -> None:
         if v < 0:
             raise ValueError(f"counters only go up, got inc({v})")
         if not _PAUSED:
             self.value += v
 
-    def to_dict(self) -> dict:
+    def to_dict(self) -> dict[str, Any]:
         return {"type": "counter", "value": self.value}
 
 
@@ -83,14 +84,14 @@ class Gauge:
 
     __slots__ = ("value",)
 
-    def __init__(self):
-        self.value = 0
+    def __init__(self) -> None:
+        self.value: int | float = 0
 
-    def set(self, v) -> None:
+    def set(self, v: int | float) -> None:
         if not _PAUSED:
             self.value = v
 
-    def to_dict(self) -> dict:
+    def to_dict(self) -> dict[str, Any]:
         return {"type": "gauge", "value": self.value}
 
 
@@ -100,7 +101,7 @@ class Histogram:
 
     __slots__ = ("bounds", "counts", "sum", "count")
 
-    def __init__(self, buckets=DEFAULT_BUCKETS):
+    def __init__(self, buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> None:
         bounds = tuple(float(b) for b in buckets)
         if not bounds or list(bounds) != sorted(set(bounds)):
             raise ValueError(f"buckets must be strictly increasing, "
@@ -110,7 +111,7 @@ class Histogram:
         self.sum = 0.0
         self.count = 0
 
-    def observe(self, v) -> None:
+    def observe(self, v: int | float) -> None:
         if _PAUSED:
             return
         v = float(v)
@@ -118,7 +119,7 @@ class Histogram:
         self.sum += v
         self.count += 1
 
-    def to_dict(self) -> dict:
+    def to_dict(self) -> dict[str, Any]:
         return {"type": "histogram", "count": self.count, "sum": self.sum,
                 "bounds": list(self.bounds), "counts": list(self.counts)}
 
@@ -127,11 +128,11 @@ class Registry:
     """Name → metric. Re-requesting a name returns the same instance;
     requesting it as a different type is an error (no silent shadowing)."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._metrics: dict = {}
+        self._metrics: dict[str, Any] = {}
 
-    def _get(self, name: str, cls, *args):
+    def _get(self, name: str, cls: type, *args: Any) -> Any:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
@@ -147,14 +148,15 @@ class Registry:
     def gauge(self, name: str) -> Gauge:
         return self._get(name, Gauge)
 
-    def histogram(self, name: str, buckets=DEFAULT_BUCKETS) -> Histogram:
+    def histogram(self, name: str,
+                  buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
         return self._get(name, Histogram, buckets)
 
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> dict[str, dict[str, Any]]:
         with self._lock:
             return {name: m.to_dict()
                     for name, m in sorted(self._metrics.items())}

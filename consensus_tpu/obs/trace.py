@@ -49,15 +49,16 @@ import json
 import os
 import threading
 import time
+from typing import IO, Any, Iterator
 
 SCHEMA_VERSION = 1
 
 _LOCK = threading.Lock()
-_SINK = None          # open file object, or None
+_SINK: IO[str] | None = None   # open file object, or None
 _ANNOTATE = False     # mirror spans into jax.profiler.TraceAnnotation
 
 
-def _scalar(v):
+def _scalar(v: Any) -> bool | int | float | str | None:
     """Coerce an attr value to a JSON scalar (numpy ints/floats included);
     anything exotic becomes its repr — a trace line must never fail to
     serialize."""
@@ -69,7 +70,8 @@ def _scalar(v):
         return repr(v)
 
 
-def configure(path=None, *, annotate_jax: bool = False) -> None:
+def configure(path: str | os.PathLike | None = None, *,
+              annotate_jax: bool = False) -> None:
     """Install the trace sink. ``path=None`` with ``annotate_jax=True``
     enables profiler annotation without writing JSONL (the ``--profile``
     -only CLI mode). Reconfiguring closes any previous sink."""
@@ -108,7 +110,7 @@ def enabled() -> bool:
 
 
 @contextlib.contextmanager
-def suspended():
+def suspended() -> Iterator[None]:
     """Temporarily suppress span/event emission (and profiler
     annotation) — used around warmup passes whose dispatches would
     otherwise be indistinguishable from the measured run's
@@ -126,7 +128,7 @@ def suspended():
             _SINK, _ANNOTATE = sink, ann
 
 
-def _emit(rec: dict) -> None:
+def _emit(rec: dict[str, Any]) -> None:
     with _LOCK:
         sink = _SINK
         if sink is None:
@@ -137,7 +139,7 @@ def _emit(rec: dict) -> None:
         sink.flush()
 
 
-def event(name: str, **attrs) -> None:
+def event(name: str, **attrs: Any) -> None:
     """Record a point-in-time event (no duration). No-op when disabled."""
     if _SINK is None:
         return
@@ -146,7 +148,7 @@ def event(name: str, **attrs) -> None:
 
 
 @contextlib.contextmanager
-def span(name: str, **attrs):
+def span(name: str, **attrs: Any) -> Iterator[dict[str, Any] | None]:
     """Time a block. Yields the attrs dict (mutate it to attach values
     known only at the end, e.g. byte counts) — or ``None`` when tracing
     is fully disabled, which is the fast path."""

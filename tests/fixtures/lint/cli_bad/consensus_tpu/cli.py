@@ -1,0 +1,10 @@
+"""Fixture flag map: 'ghost' maps to a field Config no longer has, and
+NATIVE_CLI_TPU_ONLY carries a stale exemption."""
+
+_FLAG_FIELDS = {
+    "protocol": ("protocol", "raft"),
+    "nodes": ("n_nodes", None),
+    "ghost": ("gone_field", 1),
+}
+
+NATIVE_CLI_TPU_ONLY = frozenset({"stale_field"})

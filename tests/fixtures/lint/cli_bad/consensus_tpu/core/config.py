@@ -1,0 +1,7 @@
+"""Fixture Config: `new_knob` is reachable from neither CLI."""
+
+
+class Config:
+    protocol: str = "raft"
+    n_nodes: int = 5
+    new_knob: int = 0

@@ -1,0 +1,8 @@
+// Fixture native CLI: parses a flag the shared map does not know.
+int parse(int argc, char** argv) {
+  std::string k = argv[1];
+  if (k == "--protocol") {}
+  else if (k == "--nodes") {}
+  else if (k == "--native-only") {}
+  return 0;
+}

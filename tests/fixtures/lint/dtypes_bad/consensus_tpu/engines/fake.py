@@ -1,0 +1,16 @@
+"""Seeded dtype-discipline violations (tests/test_static_analysis.py):
+a 64-bit dtype literal and dtype-defaulted constructors in device
+scope. Never imported — AST fixture only."""
+import jax.numpy as jnp
+
+
+def fake_init(n: int):
+    a = jnp.zeros(n)                    # dtype-defaulted constructor
+    b = jnp.arange(n)                   # dtype-defaulted constructor
+    c = jnp.asarray([1, 2, 3])          # literal without a stated width
+    d = jnp.zeros((n, n), jnp.int64)    # 64-bit dtype
+    return a, b, c, d
+
+
+class FakeTable:
+    K = jnp.ones(4)                     # class-level defaulted constructor

@@ -1,0 +1,15 @@
+"""Seeded purity violations (tests/test_static_analysis.py): a scan
+body with a wall-clock call, a data-dependent Python branch, and a
+Python coercion of a traced value. Never imported — AST fixture only."""
+import time
+
+import jax.numpy as jnp
+
+
+def fake_round(cfg, st, r):
+    t0 = time.time()                 # banned: host wall clock
+    if st.timer > 0:                 # banned: branch on traced value
+        bad = float(st.term)         # banned: coercion of traced value
+        return bad
+    f = lambda v: 1 if v > 0 else 2  # banned: branch on traced lambda param
+    return jnp.where(st.timer > f(st.term), st.term, st.term)

@@ -1,0 +1,34 @@
+"""Seeded registry-sync violations: a telemetry counter unknown to the
+validator, and a CRASH_SPLIT that declares `timer` persistent while the
+round's recovery code resets it. Never imported — AST fixture only."""
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops.adversary import CRASH_TELEMETRY, crash_transition, freeze_down
+
+FAKE_TELEMETRY = ("good_counter", "rogue_counter") + CRASH_TELEMETRY
+
+
+class FakeState(NamedTuple):
+    seed: object
+    term: object
+    timer: object
+    down: object
+
+
+CRASH_SPLIT = {
+    "seed": "meta",
+    "term": "persistent",
+    "timer": "persistent",   # WRONG: fake_round resets it on `rec`
+    "down": "meta",
+}
+
+
+def fake_round(cfg, st, r):
+    down, rec, crashed = crash_transition(st.seed, r, st.down, 1, 1, 0)
+    term, timer = st.term, st.timer
+    timer = jnp.where(rec, 0, timer)
+    frozen = (term, timer)
+    term, timer = freeze_down(down, frozen, (term, timer))
+    return FakeState(st.seed, term, timer, down)

@@ -1,0 +1,11 @@
+"""Fixture crash-telemetry tail. Never imported — AST fixture only."""
+CRASH_TELEMETRY = ("crashes",)
+
+
+def crash_transition(seed, r, down, crash_cut: int, recover_cut: int,
+                     max_crashed: int):
+    return down, down, down
+
+
+def freeze_down(down, frozen, new_leaves):
+    return new_leaves

@@ -1,0 +1,5 @@
+"""Fixture validator registry: 'stale_counter' is reported by no engine
+and the engine's 'rogue_counter' is missing here."""
+TELEMETRY_COUNTERS = frozenset({
+    "good_counter", "stale_counter", "crashes",
+})

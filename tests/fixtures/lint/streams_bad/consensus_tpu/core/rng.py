@@ -1,0 +1,17 @@
+"""Seeded stream-registry violations (tests/test_static_analysis.py):
+a constant collision, an unregistered constant, and a C++ mirror value
+mismatch. Never imported — AST fixture only."""
+import numpy as np
+
+STREAM_A = np.uint32(0x11111111)
+STREAM_B = np.uint32(0x11111111)   # collision with STREAM_A
+STREAM_C = np.uint32(0x22222222)   # no STREAM_KEYS entry
+STREAM_D = np.uint32(0x33333333)
+
+STREAM_KEYS = {
+    "STREAM_A": ("round", None, None),
+    "STREAM_B": ("round", None, None),
+    "STREAM_D": ("round", "src", "dst"),
+}
+STREAM_TPU_ONLY = frozenset()
+STREAM_MIXER_ONLY = frozenset({"STREAM_D"})

@@ -1,0 +1,18 @@
+"""Seeded stream call-site violations: a non-literal in a pinned absorb
+slot, an unregistered stream, and a threefry draw on a mixer-only
+stream. Never imported — AST fixture only."""
+from ..core import rng
+
+
+def draw(seed, stream, ctx, c0, c1):
+    return 0
+
+
+def fake_round(seed, r, idx):
+    a = draw(seed, rng.STREAM_A, r, idx, 0)   # pinned c0 slot varied
+    b = draw(seed, rng.STREAM_X, r, 0, 0)     # unregistered stream
+    c = draw(seed, rng.STREAM_D, r, 0, 0)     # mixer-only via threefry
+    d = draw(seed, rng.STREAM_B, r, c0=idx, c1=0)   # pinned slot via keyword
+    alias = rng.STREAM_B
+    e = draw(seed, alias, r, idx, 0)          # pinned slot via aliased stream
+    return a, b, c, d, e

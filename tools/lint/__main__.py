@@ -1,0 +1,42 @@
+"""CLI entry: ``python -m tools.lint [--check NAME ...] [--root DIR]``.
+
+Prints one line per violation and exits 1 when any check fails —
+the shape `make check` and tests/test_static_analysis.py consume.
+Stdlib-only; never imports jax or the framework.
+"""
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from . import CHECKS, run_checks
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="Repo-specific static checks for the determinism & "
+                    "parity invariants (docs/STATIC_ANALYSIS.md).")
+    ap.add_argument("--check", action="append", choices=sorted(CHECKS),
+                    help="run only this check (repeatable; default: all)")
+    ap.add_argument("--root", default="",
+                    help="repo root (default: two levels above this "
+                         "package)")
+    args = ap.parse_args(argv)
+    root = pathlib.Path(args.root) if args.root else \
+        pathlib.Path(__file__).resolve().parents[2]
+    violations = run_checks(root, only=args.check)
+    for v in violations:
+        print(f"consensus-lint: {v}", file=sys.stderr)
+    names = ", ".join(args.check) if args.check else "all checks"
+    if violations:
+        print(f"consensus-lint: FAILED ({len(violations)} violations, "
+              f"{names})", file=sys.stderr)
+        return 1
+    print(f"consensus-lint: ok ({names})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

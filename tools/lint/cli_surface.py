@@ -1,0 +1,143 @@
+"""Check `cli`: every Config field is reachable from both CLI front
+doors, or explicitly declared native-CLI-exempt.
+
+The repo's contract is ONE flag surface over two engines (SURVEY.md §2
+component 13): the Python CLI (consensus_tpu/cli.py, `_FLAG_FIELDS`)
+and the native CLI (cpp/consensus_sim.cpp) parse the same spellings,
+and the native binary re-execs the Python module for `--engine tpu`
+BEFORE strict parsing — so TPU-engine execution knobs may legitimately
+exist only on the Python side. Those are declared in cli.py:
+
+    NATIVE_CLI_TPU_ONLY = frozenset({"mesh_shape", "scan_chunk", ...})
+
+This check fails when:
+  * a Config field has no Python flag (unreachable from EITHER door);
+  * a Config field has no native flag and is not declared TPU-only
+    (the native cpu front door silently can't express it);
+  * a NATIVE_CLI_TPU_ONLY entry is stale (field gone, or the native
+    CLI actually parses it now);
+  * _FLAG_FIELDS names a field Config no longer has;
+  * the native CLI parses a config-shaped flag the shared map doesn't
+    know (the two parsers have forked).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Repo, Violation
+
+CHECK = "cli"
+
+CONFIG = "consensus_tpu/core/config.py"
+CLI = "consensus_tpu/cli.py"
+NATIVE = "cpp/consensus_sim.cpp"
+
+# Python-CLI flags handled outside _FLAG_FIELDS (the --mesh spelling of
+# mesh_shape), and native flags that are not Config fields.
+PY_SPECIAL = {"mesh_shape": "--mesh"}
+NATIVE_NON_CONFIG = {"oracle-delivery", "out", "help"}
+
+_NATIVE_FLAG_RE = re.compile(r'k == "--([a-z0-9-]+)"')
+
+
+def _config_fields(repo: Repo) -> tuple[dict[str, int], list[Violation]]:
+    for node in repo.tree(CONFIG).body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return ({n.target.id: n.lineno for n in node.body
+                     if isinstance(n, ast.AnnAssign)
+                     and isinstance(n.target, ast.Name)}, [])
+    return {}, [Violation(CHECK, CONFIG, 0, "no Config dataclass found")]
+
+
+def _flag_fields(repo: Repo) -> tuple[dict[str, str], int]:
+    """flag -> Config field from cli.py's _FLAG_FIELDS literal."""
+    for node in repo.tree(CLI).body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "_FLAG_FIELDS" \
+                and isinstance(node.value, ast.Dict):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(v, ast.Tuple) \
+                        and v.elts and isinstance(v.elts[0], ast.Constant):
+                    out[k.value] = v.elts[0].value
+            return out, node.lineno
+    return {}, 0
+
+
+def _tpu_only_decl(repo: Repo) -> tuple[set, int]:
+    for node in repo.tree(CLI).body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "NATIVE_CLI_TPU_ONLY":
+            return ({c.value for c in ast.walk(node.value)
+                     if isinstance(c, ast.Constant)
+                     and isinstance(c.value, str)}, node.lineno)
+    return set(), 0
+
+
+def check(repo: Repo) -> list[Violation]:
+    errs: list[Violation] = []
+    for rel in (CONFIG, CLI, NATIVE):
+        if not repo.exists(rel):
+            return [repo.missing(CHECK, rel)]
+    fields, errs = _config_fields(repo)
+    if not fields:
+        return errs
+    flag_map, flag_line = _flag_fields(repo)
+    if not flag_map:
+        return errs + [Violation(CHECK, CLI, 0,
+                                 "no _FLAG_FIELDS map found")]
+    tpu_only, tpu_line = _tpu_only_decl(repo)
+    cli_src = repo.read(CLI)
+    native_flags = set(_NATIVE_FLAG_RE.findall(repo.read(NATIVE)))
+
+    py_covered: dict[str, str] = {}      # field -> flag spelling
+    for flag, field in flag_map.items():
+        if field not in fields:
+            errs.append(Violation(
+                CHECK, CLI, flag_line,
+                f"_FLAG_FIELDS maps --{flag.replace('_', '-')} to "
+                f"{field!r}, which is not a Config field — the parsers "
+                "drifted"))
+            continue
+        py_covered[field] = flag.replace("_", "-")
+    for field, spelling in PY_SPECIAL.items():
+        if field in fields and spelling in cli_src:
+            py_covered[field] = spelling.lstrip("-")
+
+    for field, line in sorted(fields.items()):
+        if field not in py_covered:
+            errs.append(Violation(
+                CHECK, CONFIG, line,
+                f"Config.{field} is unreachable from the Python CLI — add "
+                "a _FLAG_FIELDS entry (or a dedicated flag) in cli.py"))
+            continue
+        native = py_covered[field] in native_flags
+        if native and field in tpu_only:
+            errs.append(Violation(
+                CHECK, CLI, tpu_line,
+                f"NATIVE_CLI_TPU_ONLY declares {field!r} but "
+                f"{NATIVE} parses --{py_covered[field]} — stale exemption"))
+        elif not native and field not in tpu_only:
+            errs.append(Violation(
+                CHECK, CONFIG, line,
+                f"Config.{field} has no native-CLI flag "
+                f"(--{py_covered[field]} not parsed by {NATIVE}) and is "
+                "not declared in cli.py NATIVE_CLI_TPU_ONLY — the native "
+                "cpu front door silently cannot express it"))
+    for field in sorted(tpu_only - set(fields)):
+        errs.append(Violation(
+            CHECK, CLI, tpu_line,
+            f"NATIVE_CLI_TPU_ONLY declares {field!r}, which is not a "
+            "Config field — stale exemption"))
+
+    known_spellings = {f.replace("_", "-") for f in flag_map} \
+        | set(NATIVE_NON_CONFIG)
+    for flag in sorted(native_flags - known_spellings):
+        errs.append(Violation(
+            CHECK, NATIVE, 0,
+            f"native CLI parses --{flag}, which the shared _FLAG_FIELDS "
+            "map does not know — the two front doors have forked"))
+    return errs

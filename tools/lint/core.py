@@ -1,0 +1,99 @@
+"""Shared infrastructure for the lint checks: a parse-caching repo view
+and the Violation record. Stdlib-only (ast + pathlib) by design — the
+lint must run in CI without importing jax or the framework."""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    check: str
+    path: str      # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+class Repo:
+    """A repo root with cached file reads and AST parses. Checks address
+    files by repo-relative POSIX path, which is what lets the fixture
+    trees under tests/fixtures/lint/ stand in for the real repo."""
+
+    def __init__(self, root) -> None:
+        self.root = pathlib.Path(root)
+        self._text: dict[str, str] = {}
+        self._ast: dict[str, ast.Module] = {}
+
+    def exists(self, rel: str) -> bool:
+        return (self.root / rel).is_file()
+
+    def read(self, rel: str) -> str:
+        if rel not in self._text:
+            self._text[rel] = (self.root / rel).read_text()
+        return self._text[rel]
+
+    def tree(self, rel: str) -> ast.Module:
+        if rel not in self._ast:
+            self._ast[rel] = ast.parse(self.read(rel), filename=rel)
+        return self._ast[rel]
+
+    def glob(self, pattern: str) -> list[str]:
+        return sorted(p.relative_to(self.root).as_posix()
+                      for p in self.root.glob(pattern) if p.is_file())
+
+    def missing(self, check: str, rel: str) -> Violation:
+        return Violation(check, rel, 0, "required file is missing")
+
+
+def dotted(node: ast.AST) -> tuple[str, ...]:
+    """The name chain of a Name/Attribute expression, outermost first:
+    ``np.random.rand`` -> ("np", "random", "rand"). Empty for anything
+    rooted in a non-Name (call results, subscripts, ...)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def literal_str_tuple(node: ast.AST, env: dict[str, tuple]) -> tuple | None:
+    """Evaluate a tuple-of-strings expression that may concatenate Name
+    references resolved through ``env`` (the `("a", "b") + CRASH_TELEMETRY`
+    idiom). Returns None when the expression has any other shape."""
+    if isinstance(node, ast.Tuple):
+        vals = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                vals.append(elt.value)
+            else:
+                return None
+        return tuple(vals)
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = literal_str_tuple(node.left, env)
+        right = literal_str_tuple(node.right, env)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def assigned_names(target: ast.AST) -> list[str]:
+    """Plain Name targets of an assignment target (tuples flattened;
+    attribute/subscript targets are ignored)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in target.elts:
+            out.extend(assigned_names(elt))
+        return out
+    return []

@@ -1,0 +1,112 @@
+"""Check `dtypes`: u32/i32 storage discipline in the device packages.
+
+The C++ oracle is uint32 end to end and digests are byte-compares of
+32-bit records, so dtype parity in engines/ and ops/ is load-bearing
+(docs/SPEC.md; engines narrow further to u8/u16 where a bound permits —
+value-identical, see raft._store_dtype). Two drift vectors are checked:
+
+  * 64-bit dtype references — jnp/np `int64`/`float64` (and their
+    string spellings in dtype= positions): under TPU x64-disabled jax
+    they silently downcast; under numpy they widen host-side math away
+    from the oracle's u32 wraparound semantics;
+  * dtype-DEFAULTED array constructors — `jnp.zeros(n)`,
+    `jnp.arange(n)`, `jnp.eye(n)` invent float32/int32 defaults that
+    jax version bumps or x64 flags can move. Every zeros/ones/empty/
+    full/eye/arange in device code must state its dtype; jnp.array/
+    jnp.asarray must state one when building from a Python literal
+    (an array argument already carries its dtype).
+
+Host-side epilogue functions (policy.HOST_EXEMPT, e.g. dpos.lib_index's
+deliberately-int64 accumulation) are exempt — they are neither traced
+nor oracle-paired.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Violation, dotted
+from . import policy
+
+CHECK = "dtypes"
+
+BANNED_64 = {"int64", "float64"}
+# func name -> index of an acceptable positional dtype argument
+# (None = dtype must be a keyword at this arity).
+NEED_DTYPE = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+              "eye": None, "arange": None}
+LITERAL_NEED_DTYPE = {"array", "asarray"}
+
+
+def _has_dtype(call: ast.Call, pos) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    return pos is not None and len(call.args) > pos
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literal(e) for e in node.elts)
+    return False
+
+
+def _check_node(rel: str, fn_name: str, node: ast.AST) -> list[Violation]:
+    errs: list[Violation] = []
+    where = f"{fn_name}: " if fn_name else ""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in BANNED_64:
+            chain = dotted(sub)
+            if chain and chain[0] in ("jnp", "np", "numpy", "jax"):
+                errs.append(Violation(
+                    CHECK, rel, sub.lineno,
+                    f"{where}{'.'.join(chain)} — 64-bit dtypes break u32 "
+                    "parity with the C++ oracle (docs/SPEC.md)"))
+        elif isinstance(sub, ast.Constant) and sub.value in BANNED_64:
+            errs.append(Violation(
+                CHECK, rel, sub.lineno,
+                f"{where}dtype string {sub.value!r} — 64-bit dtypes break "
+                "u32 parity with the C++ oracle"))
+        elif isinstance(sub, ast.Call):
+            chain = dotted(sub.func)
+            if len(chain) == 2 and chain[0] == "jnp":
+                name = chain[1]
+                if name in NEED_DTYPE \
+                        and not _has_dtype(sub, NEED_DTYPE[name]):
+                    errs.append(Violation(
+                        CHECK, rel, sub.lineno,
+                        f"{where}jnp.{name}(...) without an explicit dtype "
+                        "— defaulted dtypes drift with jax flags/versions; "
+                        "state the storage width"))
+                elif name in LITERAL_NEED_DTYPE and sub.args \
+                        and _is_literal(sub.args[0]) \
+                        and not _has_dtype(sub, 1):
+                    errs.append(Violation(
+                        CHECK, rel, sub.lineno,
+                        f"{where}jnp.{name}(<literal>) without an explicit "
+                        "dtype — a Python literal has no width; state it"))
+    return errs
+
+
+def check(repo) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in policy.device_files(repo):
+        tree = repo.tree(rel)
+        for node in tree.body:
+            fns: list[ast.FunctionDef] = []
+            if isinstance(node, ast.FunctionDef):
+                fns = [node]
+            elif isinstance(node, ast.ClassDef):
+                for n in node.body:
+                    if isinstance(n, ast.FunctionDef):
+                        fns.append(n)
+                    else:  # class-level constants are device scope too
+                        out.extend(_check_node(rel, node.name, n))
+            else:
+                out.extend(_check_node(rel, "", node))
+                continue
+            for fn in fns:
+                if policy.exempt(rel, fn.name):
+                    continue
+                out.extend(_check_node(rel, fn.name, fn))
+    return out

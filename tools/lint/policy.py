@@ -1,0 +1,39 @@
+"""Scope and exemption policy shared by the purity and dtype checks.
+
+The engines/ and ops/ packages are DEVICE code by default: every
+function in them is assumed to be (part of) a jit-traced scan body and
+must satisfy the purity and dtype disciplines. The handful of genuinely
+host-side functions that live next to their kernels — measurement
+harnesses, extraction epilogues — are exempted HERE, by name, so adding
+host-side code to an engine file is an explicit, reviewed act rather
+than something the lint silently tolerates (docs/STATIC_ANALYSIS.md).
+"""
+from __future__ import annotations
+
+# Directories (repo-relative) whose functions are device code.
+DEVICE_SCOPE = ("consensus_tpu/engines", "consensus_tpu/ops")
+
+# path -> function names that are host-side by design. Rationale:
+#   pbft_sweep: the f-ladder timing harness + host-side slice/payload
+#     epilogues (wall clocks, device->host pulls) — the ladder's traced
+#     body is pbft_round_padded/_fsweep_jit, which stay checked;
+#   dpos: lib_index is the SPEC §7 LIB extraction epilogue (host numpy,
+#     deliberately int64 — accumulation past i32 is fine off-device),
+#     dpos_run wraps runner.run around it.
+HOST_EXEMPT = {
+    "consensus_tpu/engines/pbft_sweep.py": frozenset({
+        "pbft_fsweep_timed", "_fsweep_slice", "_fsweep_device",
+        "fsweep_payload", "pbft_fsweep_run"}),
+    "consensus_tpu/engines/dpos.py": frozenset({"lib_index", "dpos_run"}),
+}
+
+
+def device_files(repo) -> list[str]:
+    out: list[str] = []
+    for d in DEVICE_SCOPE:
+        out.extend(repo.glob(f"{d}/*.py"))
+    return out
+
+
+def exempt(rel: str, fn_name: str) -> bool:
+    return fn_name in HOST_EXEMPT.get(rel, frozenset())

@@ -1,0 +1,230 @@
+"""Check `purity`: engine round/scan bodies must be traceable-pure.
+
+Every function in the device scope (engines/, ops/ — see policy.py) is
+jit-traced under vmap/scan. Four classes of construct silently break
+the determinism/parity contract when they sneak into such a body:
+
+  * host callbacks and host effects — jax.debug.*, pure_callback,
+    io_callback, host_callback, print/open/input: side channels the
+    C++ oracle cannot mirror;
+  * wall clocks and stateful RNG — time.*, random.*, np.random.*: the
+    counter-RNG discipline (docs/SPEC.md §1) is the ONLY randomness
+    allowed, precisely because it has no shared iteration order;
+  * Python coercions of traced values — float(x)/int(x)/bool(x),
+    x.item(), np.asarray(x): force a trace-time concretization (an
+    error under jit at best, a silently-baked constant at worst);
+  * data-dependent Python branching — `if`/`while`/ternary on a traced
+    value: the branch would be resolved at TRACE time from an abstract
+    value, diverging from the oracle's per-element semantics. Static
+    config branches (`if cfg.crash_cutoff > 0:`) are the approved
+    idiom and stay allowed.
+
+Taint rule (documented in docs/STATIC_ANALYSIS.md): positional
+parameters are traced unless annotated `int`/`bool`/`float`/`str` or
+named `cfg`/`self`; keyword-only parameters are static switches; a
+local becomes traced when assigned from an expression referencing a
+traced name — except through `.shape`/`.ndim`/`.dtype`/`.size`/`len()`
+(array METADATA is static under jit). `x is None` tests are trace-time
+static and exempt.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Violation, assigned_names, dotted
+from . import policy
+
+CHECK = "purity"
+
+STATIC_ANNOTATIONS = {"int", "bool", "float", "str"}
+STATIC_PARAMS = {"cfg", "self"}
+META_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+BANNED_ROOTS = {"time", "random"}
+BANNED_PREFIXES = (("np", "random"), ("numpy", "random"), ("jax", "debug"))
+BANNED_ATTRS = {"pure_callback", "io_callback", "host_callback"}
+BANNED_CALLS = {"print", "input", "open", "breakpoint", "exec", "eval"}
+COERCIONS = {"float", "int", "bool"}
+HOST_PULL_ATTRS = {"item", "tolist"}
+
+
+def _is_none_test(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops))
+
+
+class _FnChecker:
+    def __init__(self, rel: str, fn: ast.FunctionDef) -> None:
+        self.rel = rel
+        self.fn = fn
+        self.violations: list[Violation] = []
+        self.tainted: set[str] = set()
+        self._seed_params(fn)
+
+    def _seed_params(self, fn) -> None:
+        """Seed traced params of a def OR a lambda (lambdas are the
+        lax.cond/vmap-body idiom, so their params are traced too)."""
+        for a in fn.args.args + fn.args.posonlyargs:
+            if a.arg in STATIC_PARAMS:
+                continue
+            ann = a.annotation
+            if isinstance(ann, ast.Name) and ann.id in STATIC_ANNOTATIONS:
+                continue
+            self.tainted.add(a.arg)
+        if fn.args.vararg:
+            self.tainted.add(fn.args.vararg.arg)
+        # Keyword-only params are Python-level switches (telem=False).
+
+    # --- taint ---------------------------------------------------------
+
+    def taint(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in META_ATTRS:
+                return False
+            return self.taint(node.value)
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "len":
+                return False
+            parts = [node.func] + list(node.args) \
+                + [kw.value for kw in node.keywords]
+            return any(self.taint(p) for p in parts)
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Lambda):
+            return False
+        return any(self.taint(c) for c in ast.iter_child_nodes(node))
+
+    def _propagate(self) -> None:
+        """Fixpoint taint propagation over all assignments (order-free:
+        two passes suffice for the straight-line kernel style; a third
+        guards deeper chains)."""
+        for _ in range(3):
+            before = len(self.tainted)
+            for node in ast.walk(self.fn):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)) and node is not self.fn:
+                    self._seed_params(node)  # nested defs/lambdas: traced
+                targets: list[ast.AST] = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.For):
+                    targets, value = [node.target], node.iter
+                elif isinstance(node, ast.comprehension):
+                    targets, value = [node.target], node.iter
+                if value is not None and self.taint(value):
+                    for t in targets:
+                        self.tainted.update(assigned_names(t))
+            if len(self.tainted) == before:
+                break
+
+    # --- violations ----------------------------------------------------
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.violations.append(
+            Violation(CHECK, self.rel, getattr(node, "lineno", 0),
+                      f"{self.fn.name}: {msg}"))
+
+    def _check_call(self, node: ast.Call) -> None:
+        chain = dotted(node.func)
+        if chain:
+            if chain[0] in BANNED_ROOTS:
+                self._flag(node, f"host call {'.'.join(chain)}() — wall "
+                                 "clocks / stateful RNG cannot appear in a "
+                                 "traced scan body")
+            for pref in BANNED_PREFIXES:
+                if chain[:len(pref)] == pref:
+                    self._flag(node, f"host callback/RNG "
+                                     f"{'.'.join(chain)}() in a scan body")
+            if chain[-1] in BANNED_ATTRS:
+                self._flag(node, f"host callback {'.'.join(chain)}() in a "
+                                 "scan body")
+            if len(chain) == 1 and chain[0] in BANNED_CALLS:
+                self._flag(node, f"host-side {chain[0]}() in a scan body")
+            if len(chain) == 1 and chain[0] in COERCIONS \
+                    and any(self.taint(a) for a in node.args):
+                self._flag(node, f"{chain[0]}() coercion of a traced value "
+                                 "(concretizes at trace time)")
+            if chain[0] in ("np", "numpy") \
+                    and chain[-1] in ("asarray", "array") \
+                    and any(self.taint(a) for a in node.args):
+                self._flag(node, f"{'.'.join(chain)}() host materialization "
+                                 "of a traced value")
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in HOST_PULL_ATTRS:
+            self._flag(node, f".{node.func.attr}() host pull in a scan body")
+
+    def run(self) -> list[Violation]:
+        self._propagate()
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+            elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                if self.taint(node.test) and not _is_none_test(node.test):
+                    kind = ("ternary" if isinstance(node, ast.IfExp)
+                            else "branch")
+                    self._flag(node, f"data-dependent Python {kind} on a "
+                                     "traced value (use jnp.where / "
+                                     "lax.select)")
+            elif isinstance(node, ast.Assert):
+                if self.taint(node.test):
+                    self._flag(node, "assert on a traced value")
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names] \
+                    if isinstance(node, ast.Import) else [node.module or ""]
+                for m in mods:
+                    if m.split(".")[0] in BANNED_ROOTS:
+                        self._flag(node, f"import of {m} inside a scan body")
+        return self.violations
+
+
+def _banned_calls_only(rel: str, where: str, node: ast.AST) -> list:
+    """Host-call scan for module/class-level statements (no parameters,
+    so no taint — but a wall clock or stateful-RNG call at import time
+    is just as banned)."""
+    errs: list[Violation] = []
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        chain = dotted(sub.func)
+        if not chain:
+            continue
+        name = ".".join(chain)
+        if chain[0] in BANNED_ROOTS \
+                or any(chain[:len(p)] == p for p in BANNED_PREFIXES) \
+                or chain[-1] in BANNED_ATTRS \
+                or (len(chain) == 1 and chain[0] in BANNED_CALLS):
+            errs.append(Violation(
+                CHECK, rel, sub.lineno,
+                f"{where}: host call {name}() in device scope"))
+    return errs
+
+
+def check(repo) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in policy.device_files(repo):
+        tree = repo.tree(rel)
+        fns: list[ast.FunctionDef] = []
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                fns.append(node)
+            elif isinstance(node, ast.ClassDef):
+                for n in node.body:
+                    if isinstance(n, ast.FunctionDef):
+                        fns.append(n)
+                    else:  # class-level statements are device scope too
+                        out.extend(_banned_calls_only(
+                            rel, f"class {node.name}", n))
+            elif not isinstance(node, (ast.Import, ast.ImportFrom)):
+                out.extend(_banned_calls_only(rel, "module level", node))
+        for fn in fns:
+            if policy.exempt(rel, fn.name):
+                continue
+            out.extend(_FnChecker(rel, fn).run())
+    return out

@@ -1,0 +1,269 @@
+"""Check `registry`: cross-file registries that must not drift.
+
+Two registries pair engine code with validators that deliberately do
+not import it:
+
+  * TELEMETRY — each engine's on-device counter names (the *_TELEMETRY
+    tuples registered as EngineDef.telemetry_names) versus the
+    import-free known-name registry in tools/validate_trace.py
+    (TELEMETRY_COUNTERS). A name in one but not the other means the
+    schema tripwire and the engines have drifted: the validator would
+    reject fresh CLI reports (or silently accept unknown ones).
+
+  * CRASH_SPLIT — SPEC §6c requires every engine to partition its carry
+    into persistent state (survives a crash; what the protocol's safety
+    argument rests on) and volatile state (reset on recovery). The
+    split used to live only in each round function's reset code; each
+    engine now DECLARES it:
+
+        CRASH_SPLIT = {"term": "persistent", "role": "volatile",
+                       "seed": "meta", ...}
+
+    and this check verifies the declaration against the actual code:
+    keys cover the state NamedTuple exactly; the fields reset on the
+    recovery mask (`x = jnp.where(rec, ...)`) are exactly the declared
+    volatile set; and when the round freezes down nodes
+    (freeze_down/_freeze), the frozen tuple covers exactly the
+    persistent+volatile fields ("meta" fields — the seed, the down mask
+    itself, and slot-lifecycle state with its own management — stay
+    outside). A wrong declaration OR a reset-code change without a
+    declaration update fails here, not in a crash-churn scenario three
+    PRs later.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Repo, Violation, assigned_names, dotted, literal_str_tuple
+
+CHECK = "registry"
+
+ENGINES_GLOB = "consensus_tpu/engines/*.py"
+ADVERSARY = "consensus_tpu/ops/adversary.py"
+VALIDATOR = "tools/validate_trace.py"
+SPLIT_KINDS = {"persistent", "volatile", "meta"}
+FREEZE_FNS = {"freeze_down", "_freeze"}
+
+
+# --- telemetry -------------------------------------------------------------
+
+def _module_str_tuples(tree: ast.Module, env: dict) -> dict[str, tuple]:
+    out: dict[str, tuple] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            val = literal_str_tuple(node.value, env | out)
+            if val is not None:
+                out[node.targets[0].id] = val
+    return out
+
+
+def _validator_counters(repo: Repo) -> tuple[set, int] | None:
+    for node in repo.tree(VALIDATOR).body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "TELEMETRY_COUNTERS":
+            names = {c.value for c in ast.walk(node.value)
+                     if isinstance(c, ast.Constant)
+                     and isinstance(c.value, str)}
+            return names, node.lineno
+    return None
+
+
+def _telemetry_violations(repo: Repo) -> list[Violation]:
+    if not repo.exists(VALIDATOR):
+        return [repo.missing(CHECK, VALIDATOR)]
+    got = _validator_counters(repo)
+    if got is None:
+        return [Violation(CHECK, VALIDATOR, 0,
+                          "no TELEMETRY_COUNTERS registry found")]
+    registry, reg_line = got
+    env: dict[str, tuple] = {}
+    if repo.exists(ADVERSARY):
+        env.update(_module_str_tuples(repo.tree(ADVERSARY), {}))
+    engine_names: set[str] = set()
+    errs: list[Violation] = []
+    for rel in repo.glob(ENGINES_GLOB):
+        tuples = _module_str_tuples(repo.tree(rel), env)
+        for name, val in tuples.items():
+            if name.endswith("TELEMETRY"):
+                engine_names.update(val)
+                for counter in val:
+                    if counter not in registry:
+                        errs.append(Violation(
+                            CHECK, rel, 0,
+                            f"telemetry counter {counter!r} ({name}) is "
+                            f"missing from {VALIDATOR} TELEMETRY_COUNTERS "
+                            "— the CLI-report tripwire would reject it"))
+    engine_names.update(env.get("CRASH_TELEMETRY", ()))
+    for counter in sorted(registry - engine_names):
+        errs.append(Violation(
+            CHECK, VALIDATOR, reg_line,
+            f"TELEMETRY_COUNTERS entry {counter!r} is reported by no "
+            "engine — stale registry entry"))
+    return errs
+
+
+# --- CRASH_SPLIT -----------------------------------------------------------
+
+def _named_tuples(repo: Repo) -> dict[str, list[str]]:
+    """State-class name -> field names, across the engines package."""
+    out: dict[str, list[str]] = {}
+    for rel in repo.glob(ENGINES_GLOB):
+        for node in repo.tree(rel).body:
+            if isinstance(node, ast.ClassDef) and any(
+                    dotted(b)[-1:] == ("NamedTuple",) for b in node.bases):
+                out[node.name] = [
+                    n.target.id for n in node.body
+                    if isinstance(n, ast.AnnAssign)
+                    and isinstance(n.target, ast.Name)]
+    return out
+
+
+def _crash_split_decl(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "CRASH_SPLIT" \
+                and isinstance(node.value, ast.Dict):
+            decl = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(v, ast.Constant):
+                    decl[k.value] = v.value
+            return decl, node.lineno
+    return None
+
+
+def _calls_name(fn: ast.AST, names: set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = dotted(node.func)
+            if chain and chain[-1] in names:
+                return True
+    return False
+
+
+def _round_analysis(fn: ast.FunctionDef, fields: list[str]):
+    """(reset_fields, frozen_fields | None) from a round function."""
+    alias = {f: f for f in fields}
+    field_set = set(fields)
+    # x = st.field / a, b = st.a, st.b  — alias locals to carry fields.
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt, val = node.targets[0], node.value
+        pairs = []
+        if isinstance(tgt, ast.Name) and isinstance(val, ast.Attribute):
+            pairs = [(tgt, val)]
+        elif isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple) \
+                and len(tgt.elts) == len(val.elts):
+            pairs = list(zip(tgt.elts, val.elts))
+        for t, v in pairs:
+            if isinstance(t, ast.Name) and isinstance(v, ast.Attribute) \
+                    and v.attr in field_set:
+                alias[t.id] = v.attr
+
+    def to_field(node: ast.AST):
+        if isinstance(node, ast.Name):
+            return alias.get(node.id)
+        if isinstance(node, ast.Attribute) and node.attr in field_set:
+            return node.attr
+        return None
+
+    reset: set[str] = set()
+    frozen: set[str] | None = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            val = node.value
+            if isinstance(val, ast.Call) \
+                    and dotted(val.func)[-1:] == ("where",) and val.args:
+                uses_rec = any(isinstance(n, ast.Name) and n.id == "rec"
+                               for n in ast.walk(val.args[0]))
+                if uses_rec:
+                    for t in node.targets:
+                        for name in assigned_names(t):
+                            f = alias.get(name)
+                            if f:
+                                reset.add(f)
+            for t in node.targets:
+                if assigned_names(t) == ["frozen"] \
+                        and isinstance(val, ast.Tuple):
+                    frozen = {f for f in map(to_field, val.elts) if f}
+    return reset, frozen
+
+
+def _crash_split_violations(repo: Repo) -> list[Violation]:
+    classes = _named_tuples(repo)
+    errs: list[Violation] = []
+    for rel in repo.glob(ENGINES_GLOB):
+        tree = repo.tree(rel)
+        rounds = [n for n in tree.body if isinstance(n, ast.FunctionDef)
+                  and _calls_name(n, {"crash_transition"})]
+        if not rounds:
+            continue
+        decl = _crash_split_decl(tree)
+        if decl is None:
+            errs.append(Violation(
+                CHECK, rel, 0,
+                "engine implements the SPEC §6c crash adversary "
+                "(crash_transition call) but declares no CRASH_SPLIT — "
+                "add the persistent/volatile/meta carry declaration"))
+            continue
+        split, line = decl
+        bad_kinds = {k: v for k, v in split.items()
+                     if v not in SPLIT_KINDS}
+        for k, v in bad_kinds.items():
+            errs.append(Violation(
+                CHECK, rel, line,
+                f"CRASH_SPLIT[{k!r}] = {v!r}: kind must be one of "
+                f"{sorted(SPLIT_KINDS)}"))
+        for fn in rounds:
+            state_cls = next(
+                (dotted(n.func)[-1] for n in ast.walk(fn)
+                 if isinstance(n, ast.Call)
+                 and dotted(n.func)[-1:] != ()
+                 and dotted(n.func)[-1] in classes), None)
+            if state_cls is None:
+                errs.append(Violation(
+                    CHECK, rel, fn.lineno,
+                    f"{fn.name}: cannot find the state NamedTuple this "
+                    "round constructs — CRASH_SPLIT is uncheckable"))
+                continue
+            fields = classes[state_cls]
+            if set(split) != set(fields):
+                missing = sorted(set(fields) - set(split))
+                extra = sorted(set(split) - set(fields))
+                errs.append(Violation(
+                    CHECK, rel, line,
+                    f"CRASH_SPLIT keys != {state_cls} fields "
+                    f"(missing: {missing}, stale: {extra})"))
+                continue
+            declared_vol = {k for k, v in split.items() if v == "volatile"}
+            declared_per = {k for k, v in split.items() if v == "persistent"}
+            reset, frozen = _round_analysis(fn, fields)
+            if reset != declared_vol:
+                errs.append(Violation(
+                    CHECK, rel, fn.lineno,
+                    f"{fn.name}: recovery-reset fields {sorted(reset)} != "
+                    f"declared volatile {sorted(declared_vol)} — a "
+                    "persistent field reset on `rec` rolls durable state "
+                    "back; a volatile field NOT reset leaks pre-crash "
+                    "state into the rejoin"))
+            if _calls_name(fn, FREEZE_FNS):
+                want = declared_per | declared_vol
+                if frozen is None:
+                    errs.append(Violation(
+                        CHECK, rel, fn.lineno,
+                        f"{fn.name}: freeze call without a recognizable "
+                        "`frozen = (...)` tuple"))
+                elif frozen != want:
+                    errs.append(Violation(
+                        CHECK, rel, fn.lineno,
+                        f"{fn.name}: frozen tuple covers {sorted(frozen)} "
+                        f"but persistent+volatile = {sorted(want)} — an "
+                        "uncovered field lets a down node's state move"))
+    return errs
+
+
+def check(repo: Repo) -> list[Violation]:
+    return _telemetry_violations(repo) + _crash_split_violations(repo)

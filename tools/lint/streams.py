@@ -1,0 +1,261 @@
+"""Check `streams`: the counter-RNG stream registry and its call sites.
+
+Every random decision in the simulator draws from a per-purpose STREAM_*
+constant (docs/SPEC.md §1). Two silent failure modes motivate this
+check:
+
+  * a stream-constant COLLISION (two purposes keyed identically) makes
+    logically-independent adversary events correlated across features —
+    no test notices until a scenario happens to co-activate both;
+  * an absorb-key ARITY drift — a call site varying a key slot the
+    stream's definition pins to a constant (or vice versa) — reuses
+    counter space another draw owns, the same correlation bug in
+    different clothes.
+
+core/rng.py therefore carries a machine-checked registry:
+
+    STREAM_KEYS = {"STREAM_TIMEOUT": ("term", None, "node"), ...}
+
+naming, for each stream, what each of the three absorb slots
+(ctx, c0, c1) keys — `None` meaning "pinned: every call site must pass
+a literal constant". This check enforces:
+
+  1. every STREAM_* constant is registered in STREAM_KEYS and vice
+     versa, and all constant values are unique;
+  2. every threefry call site (draw/_draw/random_u32_*) uses a
+     registered stream and passes literal constants in pinned slots;
+  3. mixer-only streams (STREAM_MIXER_ONLY — the SPEC §2 delivery
+     stream) are never drawn through the threefry entry points;
+  4. the C++ mirror (cpp/threefry.h) defines the same constants with
+     the same values — minus STREAM_TPU_ONLY (e.g. STREAM_CRASH: SPEC
+     §6c is not implemented by the oracle, and Config rejects it on
+     engine="cpu").
+
+Scope: call sites across consensus_tpu/ only. tests/ and benchmarks/
+deliberately drive raw streams for cross-validation and ablations.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Repo, Violation, dotted
+
+CHECK = "streams"
+
+RNG = "consensus_tpu/core/rng.py"
+CPP_MIRROR = "cpp/threefry.h"
+DRAW_FNS = {"draw", "_draw", "random_u32_np", "random_u32_jnp"}
+_CPP_RE = re.compile(
+    r"\bSTREAM_([A-Z_0-9]+)\s*=\s*0[xX]([0-9A-Fa-f]+)u?")
+
+
+def _parse_rng(repo: Repo):
+    """(streams: name->(value, line), keys: name->3-tuple,
+    tpu_only: set, mixer_only: set, violations)."""
+    errs: list[Violation] = []
+    streams: dict[str, tuple[int, int]] = {}
+    keys: dict[str, tuple] = {}
+    tpu_only: set[str] = set()
+    mixer_only: set[str] = set()
+    tree = repo.tree(RNG)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        name = node.targets[0].id
+        if name in ("STREAM_KEYS", "STREAM_TPU_ONLY", "STREAM_MIXER_ONLY"):
+            pass  # registry/exemption declarations, handled below
+        elif name.startswith("STREAM_") and isinstance(node.value, ast.Call):
+            chain = dotted(node.value.func)
+            if chain[-1:] == ("uint32",) and node.value.args \
+                    and isinstance(node.value.args[0], ast.Constant):
+                streams[name] = (int(node.value.args[0].value), node.lineno)
+        if name == "STREAM_KEYS" and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(v, ast.Tuple) and len(v.elts) == 3
+                        and all(isinstance(e, ast.Constant)
+                                for e in v.elts)):
+                    errs.append(Violation(
+                        CHECK, RNG, node.lineno,
+                        "STREAM_KEYS entries must be 'STREAM_X': "
+                        "(ctx, c0, c1) literal 3-tuples (None = pinned "
+                        "slot)"))
+                    continue
+                keys[k.value] = tuple(e.value for e in v.elts)
+        elif name in ("STREAM_TPU_ONLY", "STREAM_MIXER_ONLY"):
+            found: set[str] = set()
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    found.add(sub.value)
+            (tpu_only if name == "STREAM_TPU_ONLY" else mixer_only) \
+                .update(found)
+    return streams, keys, tpu_only, mixer_only, errs
+
+
+def _registry_violations(streams, keys, tpu_only, mixer_only) -> list:
+    errs = []
+    for name, (_, line) in streams.items():
+        if name not in keys:
+            errs.append(Violation(
+                CHECK, RNG, line,
+                f"{name} has no STREAM_KEYS entry — declare its absorb-key "
+                "slots (docs/STATIC_ANALYSIS.md)"))
+    for name in keys:
+        if name not in streams:
+            errs.append(Violation(
+                CHECK, RNG, 0,
+                f"STREAM_KEYS entry {name} has no STREAM constant"))
+    for extra in (tpu_only | mixer_only) - set(streams):
+        errs.append(Violation(
+            CHECK, RNG, 0,
+            f"declared exemption {extra} is not a defined stream"))
+    by_value: dict[int, list[str]] = {}
+    for name, (value, _) in streams.items():
+        by_value.setdefault(value, []).append(name)
+    for value, names in sorted(by_value.items()):
+        if len(names) > 1:
+            line = min(streams[n][1] for n in names)
+            errs.append(Violation(
+                CHECK, RNG, line,
+                f"stream constant collision: {', '.join(sorted(names))} all "
+                f"= 0x{value:08X} — colliding streams silently correlate "
+                "independent adversary events"))
+    return errs
+
+
+def _cpp_violations(repo: Repo, streams, tpu_only) -> list:
+    if not repo.exists(CPP_MIRROR):
+        return [repo.missing(CHECK, CPP_MIRROR)]
+    cpp = {"STREAM_" + m.group(1): int(m.group(2), 16)
+           for m in _CPP_RE.finditer(repo.read(CPP_MIRROR))}
+    errs = []
+    for name, (value, line) in sorted(streams.items()):
+        if name in tpu_only:
+            if name in cpp:
+                errs.append(Violation(
+                    CHECK, RNG, line,
+                    f"{name} is declared STREAM_TPU_ONLY but {CPP_MIRROR} "
+                    "defines it — drop the stale exemption"))
+            continue
+        if name not in cpp:
+            errs.append(Violation(
+                CHECK, RNG, line,
+                f"{name} missing from {CPP_MIRROR} (or declare it "
+                "STREAM_TPU_ONLY if the oracle must not mirror it)"))
+        elif cpp[name] != value:
+            errs.append(Violation(
+                CHECK, RNG, line,
+                f"{name} = 0x{value:08X} here but 0x{cpp[name]:08X} in "
+                f"{CPP_MIRROR} — the engines would draw different streams"))
+    for name in sorted(set(cpp) - set(streams)):
+        errs.append(Violation(
+            CHECK, CPP_MIRROR, 0,
+            f"{name} defined in the C++ mirror but not in {RNG}"))
+    return errs
+
+
+# The shared signature of every threefry entry point:
+#   draw(seed, stream, ctx, c0, c1)  /  random_u32_*(seed, stream, ctx, c0, c1)
+_SLOT_NAMES = ("ctx", "c0", "c1")
+_SLOT_POS = {"ctx": 2, "c0": 3, "c1": 4}
+
+
+def _stream_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local names bound to a STREAM_* constant anywhere in the module
+    (`s = rng.STREAM_CHURN`) — so aliasing a stream cannot bypass the
+    call-site checks."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            chain = dotted(node.value)
+            if chain and chain[-1].startswith("STREAM_"):
+                out[node.targets[0].id] = chain[-1]
+    return out
+
+
+def _resolve_stream(arg: ast.AST, aliases: dict[str, str]) -> str | None:
+    chain = dotted(arg)
+    if not chain:
+        return None
+    if chain[-1].startswith("STREAM_"):
+        return chain[-1]
+    if len(chain) == 1:
+        return aliases.get(chain[0])
+    return None
+
+
+def _slot_args(node: ast.Call) -> dict[str, ast.AST | None]:
+    """The (ctx, c0, c1) argument expressions of a draw call, whether
+    passed positionally or by keyword; None when absent/unresolvable
+    (callers flag pinned slots they cannot see — never skip silently)."""
+    out: dict[str, ast.AST | None] = {s: None for s in _SLOT_NAMES}
+    for slot, pos in _SLOT_POS.items():
+        if len(node.args) > pos:
+            out[slot] = node.args[pos]
+    for kw in node.keywords:
+        if kw.arg in out:
+            out[kw.arg] = kw.value
+    return out
+
+
+def _call_site_violations(repo: Repo, keys, mixer_only) -> list:
+    errs = []
+    for rel in repo.glob("consensus_tpu/**/*.py"):
+        if rel == RNG:
+            continue  # the registry's own module builds the generic keys
+        tree = repo.tree(rel)
+        aliases = _stream_aliases(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            if not chain or chain[-1] not in DRAW_FNS:
+                continue
+            sarg = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "stream"),
+                None)
+            stream = _resolve_stream(sarg, aliases) if sarg is not None \
+                else None
+            if stream is None:
+                continue  # generic pass-through (a `stream` parameter)
+            if stream in mixer_only:
+                errs.append(Violation(
+                    CHECK, rel, node.lineno,
+                    f"{stream} is mixer-only (SPEC §2 delivery): draw it "
+                    "through delivery_u32_*, not the threefry entry points"))
+                continue
+            if stream not in keys:
+                errs.append(Violation(
+                    CHECK, rel, node.lineno,
+                    f"call site uses unregistered stream {stream} — add a "
+                    f"STREAM_KEYS entry in {RNG}"))
+                continue
+            slots = _slot_args(node)
+            for i, slot in enumerate(_SLOT_NAMES):
+                if keys[stream][i] is None and not isinstance(
+                        slots[slot], ast.Constant):
+                    errs.append(Violation(
+                        CHECK, rel, node.lineno,
+                        f"{stream} pins absorb slot {slot} (STREAM_KEYS "
+                        "declares it None) but this call site passes a "
+                        "non-literal (or unrecognizable) argument — "
+                        "counter-space reuse correlates draws across "
+                        "purposes"))
+    return errs
+
+
+def check(repo: Repo) -> list[Violation]:
+    if not repo.exists(RNG):
+        return [repo.missing(CHECK, RNG)]
+    streams, keys, tpu_only, mixer_only, errs = _parse_rng(repo)
+    if not streams:
+        errs.append(Violation(CHECK, RNG, 0, "no STREAM_* constants found"))
+        return errs
+    errs += _registry_violations(streams, keys, tpu_only, mixer_only)
+    errs += _cpp_violations(repo, streams, tpu_only)
+    errs += _call_site_violations(repo, keys, mixer_only)
+    return errs
