@@ -1,9 +1,11 @@
 # Repo-level entry points. The whole gate is ONE command:
 #
-#   make check     # consensus-lint + ruff + mypy + clang-tidy + tier-1
+#   make check     # consensus-lint + hlocheck + ruff + mypy + clang-tidy
+#                  # + tier-1
 #
-# (tools/check.py gates ruff/mypy/clang-tidy on availability and prints
-# a per-layer summary; see docs/STATIC_ANALYSIS.md.)
+# (tools/check.py gates hlocheck on jax and ruff/mypy/clang-tidy on
+# availability and prints a per-layer summary; see
+# docs/STATIC_ANALYSIS.md.)
 
 PY ?= python
 
@@ -12,6 +14,9 @@ check:
 
 lint:
 	$(PY) -m tools.lint
+
+hlocheck:
+	$(PY) -m tools.hlocheck
 
 tidy:
 	$(MAKE) -C cpp tidy
@@ -24,4 +29,4 @@ test:
 	  --continue-on-collection-errors -p no:cacheprovider \
 	  -p no:xdist -p no:randomly
 
-.PHONY: check lint tidy san-test test
+.PHONY: check lint hlocheck tidy san-test test

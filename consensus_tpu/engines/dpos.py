@@ -33,6 +33,14 @@ class DposState(NamedTuple):
 # the chain is durable and dpos carries NO volatile per-node state — a
 # down validator simply stops appending (the round masks `append` with
 # the down flags), so there is no recovery reset and no freeze call.
+# Compiled-program contract (tools/hlocheck): the 181M-steps/s engine —
+# one fusion per round at the HBM floor, zero sort-class passes in the
+# ROUND program (the epoch top-21 argsort runs once in make_carry, i.e.
+# in _init_jit, outside the scanned chunk hlocheck budgets).
+# node_sharded="zero": no carry leaf is node-indexed, so a node-sharded
+# round program must emit NO collectives at all.
+PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=0, node_sharded="zero")
+
 CRASH_SPLIT = {
     "seed": "meta",
     "chain_r": "persistent",

@@ -44,6 +44,11 @@ class PaxosState(NamedTuple):
 # increase across rounds, so no later prepare can be outbid by a
 # forgotten promise (SPEC §6c); acc_bal/acc_val (the accepted-value
 # history Paxos safety rests on) and the learner state persist.
+# Compiled-program contract (tools/hlocheck): sort-free (quorum counts
+# are plain reductions over the [N, S] grid); cumsum covers the slot
+# brackets. No node-sharded claim (digest-tested only, like dense raft).
+PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=6, node_sharded=None)
+
 CRASH_SPLIT = {
     "seed": "meta",
     "promised": "volatile",

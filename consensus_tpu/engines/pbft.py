@@ -44,6 +44,13 @@ class PbftState(NamedTuple):
 # is the persisted state PBFT's safety argument rests on. Shared by the
 # §6b bcast engine (same PbftState, same split — engines/pbft_bcast.py
 # declares it independently so the lint checks each round's code).
+# Compiled-program contract (tools/hlocheck): the dense §6 kernel
+# tallies pairwise — sort-free by design (budget 0 keeps it that way);
+# cumsum passes are the slot brackets. No node-sharded claim: the dense
+# [i, j, s] tensors are the engine the §6b bcast kernel exists to
+# replace at scale.
+PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=11, node_sharded=None)
+
 CRASH_SPLIT = {
     "seed": "meta",
     "view": "volatile",

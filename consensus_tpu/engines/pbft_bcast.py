@@ -53,6 +53,18 @@ I32_MAX = jnp.iinfo(jnp.int32).max
 # kernel's (engines/pbft.py: the fault granularity changes, the state
 # split does not); declared per-module so tools/lint (check `registry`)
 # verifies THIS round's reset/freeze code.
+# Compiled-program contract (tools/hlocheck): THE sort-class-bound round
+# (docs/PERF.md — carry-bandwidth floor 0.6% of HBM peak, the bytes are
+# sort temporaries). 3 sort passes/round compiled today (the two
+# _SortedTally payload sorts + the §2 partition-side order statistic);
+# the ROADMAP bandwidth-floor item exists to LOWER this number — the
+# budget is the ceiling that guarantees it can only go down. No
+# node-sharded claim yet: GSPMD currently gathers full [N, S]-class
+# operands when the node axis is sharded (measured, hlocheck registry
+# notes) — flipping this to "bounded" is the acceptance bar for the
+# mesh-scaling refactor.
+PROGRAM_CONTRACT = dict(sort_budget=3, cumsum_budget=33, node_sharded=None)
+
 CRASH_SPLIT = {
     "seed": "meta",
     "view": "volatile",

@@ -53,6 +53,16 @@ class RaftState(NamedTuple):
 # (the per-sweep seed and the down mask itself) sit outside the split.
 # timeout is persistent because it is a pure function of (seed, term,
 # id) and the term persists — recomputing it on rejoin is a no-op.
+# Compiled-program contract (tools/hlocheck, docs/STATIC_ANALYSIS.md
+# "compiled-program layer"): regression CEILINGS on the lowered round
+# program — the sort-diet work may lower them, never raise them. The
+# dense [N, N] kernel is sort-free; its cumsum passes are the log-match
+# brackets at benchmark L (shape-dependent lowering: the 5-node config
+# compiles them away entirely). No node-sharded claim: the dense
+# engine's multi-chip story is digest-tested (test_runner), not
+# structure-claimed — the capped §3b engine owns that claim.
+PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=21, node_sharded=None)
+
 CRASH_SPLIT = {
     "seed": "meta",
     "term": "persistent",

@@ -90,6 +90,15 @@ class RaftSparseState(NamedTuple):
 # but a cache keyed by lead_id, whose lifecycle re-initializes rows at
 # (re-)election and never tracks a down node, so recovery resets and
 # the down-freeze both bypass them by construction.
+# Compiled-program contract (tools/hlocheck): 2 sorts/round (the §3b
+# tracked-set maintenance) is the ceiling; cumsum covers the capped
+# tally brackets. node_sharded="strict" is the repo's multi-chip claim
+# (ROADMAP, tests/test_mesh_collectives.py): under node sharding the
+# round stays in the all-reduce family at the canonical shape and every
+# collective is O(N) metadata at flagship N — never the [N, L] carry.
+PROGRAM_CONTRACT = dict(sort_budget=2, cumsum_budget=30,
+                        node_sharded="strict")
+
 CRASH_SPLIT = {
     "seed": "meta",
     "term": "persistent",

@@ -84,10 +84,23 @@ def _init_jit(cfg: Config, eng: EngineDef, seeds, *, mesh=None):
     return meshlib.constrain(carry, cfg, mesh, eng.carry_pspec(cfg))
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("mesh",))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("mesh",),
+                   donate_argnums=(3, 5))
 def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0,
                telem=None, *, mesh=None):
     """Advance the batched carry by ``n_rounds`` rounds starting at ``r0``.
+
+    The carry (and the telemetry accumulator, when present) is DONATED:
+    every input leaf has a same-shape/dtype output leaf, so XLA aliases
+    the buffers (``input_output_alias`` in the compiled module —
+    statically enforced by ``tools/hlocheck``'s donation contract) and a
+    chunked run holds ONE carry instead of two across dispatches — the
+    ROADMAP bandwidth lever at 100k-node carries. Consequences at the
+    call sites: the passed-in carry is dead after the call (callers must
+    rebind, which they all did already), and any reference that must
+    outlive the next dispatch — the async checkpoint writer's pending
+    snapshot — must be a copy (see :func:`_snapshot_copy`). Inside an
+    outer jit trace (``__graft_entry__.entry``) donation is inert.
 
     The round body must stay inside a scan of length >= 2: XLA unrolls a
     length-1 scan into the top-level computation, and the CPU backend's
@@ -140,6 +153,21 @@ def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0,
         xs = r0 + jnp.arange(n_rounds, dtype=jnp.int32)
     (carry, telem), _ = jax.lax.scan(body, (carry, telem), xs)
     return (carry, telem) if telemetry else carry
+
+
+def _snapshot_copy(carry):
+    """Device-side copy of the carry for the async checkpoint writer.
+
+    ``_chunk_jit`` donates its carry, so the buffers a pending snapshot
+    references are reused by the very next dispatch — the writer thread's
+    device→host pull would race the overwrite (jax surfaces it as
+    "Array has been deleted", but only when the dispatch wins). The copy
+    is dispatched asynchronously BEFORE that donation, ordered on the
+    device stream, so the writer owns stable buffers while the original
+    is recycled. Costs one carry of HBM traffic per checkpoint interval
+    — the donation saves the same amount on every round in between.
+    Sharding is preserved leaf-wise (``jnp.copy`` keeps it)."""
+    return jax.tree.map(jnp.copy, carry)
 
 
 @jax.jit
@@ -622,8 +650,11 @@ def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
         r += n
         if checkpoint_path and r < cfg.n_rounds:
             if writer is not None:
-                writer.submit(checkpoint_path, cfg, carry, r, seeds=seeds,
-                              keep=keep, fsync=fsync)
+                # The writer's pull overlaps the NEXT dispatch, which
+                # donates (and so recycles) this carry's buffers — hand
+                # the writer its own copy (see _snapshot_copy).
+                writer.submit(checkpoint_path, cfg, _snapshot_copy(carry),
+                              r, seeds=seeds, keep=keep, fsync=fsync)
             else:
                 rec = save_checkpoint(checkpoint_path, cfg, carry, r,
                                       seeds=seeds, keep=keep, fsync=fsync)

@@ -1,0 +1,122 @@
+"""Seeded-violation engines for the hlocheck contracts
+(tests/test_hlocheck.py — the compiled-program sibling of
+tests/fixtures/lint/'s AST fixture trees).
+
+Each fake engine compiles a real program through the PRODUCTION lowering
+path (tools/hlocheck/hlo.compiled_text over runner._chunk_jit) that
+violates exactly one contract, proving the check fires on compiler
+output, not on source patterns:
+
+  * ``f64_engine``        — a float64 promotion (lowered under
+    ``jax.experimental.enable_x64`` so the wide type survives jax's
+    canonicalization, exactly how a real leak would arrive: an env
+    flag flipping x64 on) → ``dtypes``;
+  * ``gather_engine``     — a data-dependent global permutation of the
+    [N, L] log under node sharding: GSPMD has no local rewrite, so it
+    all-gathers the FULL carry leaf → ``collectives``;
+  * ``callback_engine``   — a ``jax.pure_callback`` inside the round →
+    ``host_boundary`` (custom-call to xla_python_cpu_callback);
+  * ``sorty_engine``      — two payload sorts against a declared
+    ``sort_budget=1`` → ``sort_budget``;
+  * ``ok_engine`` + ``undonated_chunk`` — a clean round lowered through
+    a jit twin WITHOUT ``donate_argnums`` → ``donation`` (and through
+    the production jit it passes everything: the negative control).
+
+``undonated_chunk`` doubles as the bit-identity REFERENCE for the
+donation satellite (tests/test_donation.py): same scan semantics as
+``runner._chunk_jit`` minus masking/telemetry/donation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.network.runner import EngineDef
+from consensus_tpu.parallel.mesh import NODE_AXIS
+
+CFG = Config(protocol="raft", n_nodes=32, n_rounds=4, n_sweeps=2,
+             log_capacity=8, max_entries=4)
+
+
+class FakeCarry(NamedTuple):
+    vals: jnp.ndarray   # [N] u32
+    log: jnp.ndarray    # [N, L] i32
+
+
+def _make_carry(cfg: Config, seed) -> FakeCarry:
+    n, ell = cfg.n_nodes, cfg.log_capacity
+    return FakeCarry(
+        vals=jnp.full((n,), seed, jnp.uint32)
+        + jnp.arange(n, dtype=jnp.uint32),
+        log=jnp.zeros((n, ell), jnp.int32))
+
+
+def _pspec(cfg: Config) -> FakeCarry:
+    return FakeCarry(vals=P(NODE_AXIS), log=P(NODE_AXIS, None))
+
+
+def _extract(c: FakeCarry) -> dict:
+    return {"vals": c.vals}
+
+
+def _engine(round_fn, name: str) -> EngineDef:
+    return EngineDef(name, _make_carry, round_fn, _extract, _pspec)
+
+
+def _ok_round(cfg: Config, c: FakeCarry, r) -> FakeCarry:
+    return FakeCarry(vals=c.vals + jnp.uint32(1), log=c.log + 1)
+
+
+def _f64_round(cfg: Config, c: FakeCarry, r) -> FakeCarry:
+    # Only widens when x64 is enabled — lower inside
+    # jax.experimental.enable_x64(True), like the env-flag leak it seeds.
+    wide = c.log.astype(jnp.float64) * 1.5
+    return FakeCarry(vals=c.vals + jnp.uint32(1),
+                     log=wide.astype(jnp.int32))
+
+
+def _gather_round(cfg: Config, c: FakeCarry, r) -> FakeCarry:
+    # Global data-dependent permutation: every shard needs every row, so
+    # the partitioner all-gathers the full [N, L] leaf (the "bad
+    # sharding annotation" failure class: the pspec promises node
+    # sharding the computation then un-does).
+    order = jnp.argsort(c.vals)
+    return FakeCarry(vals=c.vals + jnp.uint32(1), log=c.log[order])
+
+
+def _callback_round(cfg: Config, c: FakeCarry, r) -> FakeCarry:
+    v = jax.pure_callback(
+        lambda x: x, jax.ShapeDtypeStruct(c.vals.shape, c.vals.dtype),
+        c.vals, vmap_method="sequential")
+    return FakeCarry(vals=v + jnp.uint32(1), log=c.log + 1)
+
+
+def _sorty_round(cfg: Config, c: FakeCarry, r) -> FakeCarry:
+    s1 = jnp.sort(c.vals)
+    s2 = jnp.sort(c.log, axis=0)
+    return FakeCarry(vals=s1 + jnp.uint32(1), log=s2 + 1)
+
+
+ok_engine = _engine(_ok_round, "fake-ok")
+f64_engine = _engine(_f64_round, "fake-f64")
+gather_engine = _engine(_gather_round, "fake-gather")
+callback_engine = _engine(_callback_round, "fake-callback")
+sorty_engine = _engine(_sorty_round, "fake-sorty")
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2),
+                   static_argnames=("mesh",))
+def undonated_chunk(cfg, eng, n_rounds, carry, r0, telem=None, *, mesh=None):
+    """runner._chunk_jit minus donate_argnums (and minus the length-1
+    masking / telemetry paths neither fixture needs): the un-donated
+    carry seeded violation, and the donation bit-identity reference."""
+    def body(c, r):
+        return jax.vmap(lambda s: eng.round_fn(cfg, s, r))(c), None
+    carry, _ = jax.lax.scan(body, carry,
+                            r0 + jnp.arange(n_rounds, dtype=jnp.int32))
+    return carry

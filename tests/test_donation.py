@@ -1,0 +1,126 @@
+"""Carry donation in the chunked round loop (ROADMAP bandwidth lever).
+
+``runner._chunk_jit`` donates its carry (and telemetry accumulator):
+XLA aliases every input buffer to its same-shaped output
+(``input_output_alias``, statically enforced by tools/hlocheck's
+donation contract), so a chunked run holds ONE carry across dispatches
+instead of two. Donation is an allocation strategy, not a semantic
+change — these tests pin that across all six engines, including the
+two paths where a stale reference could observe the buffer reuse:
+
+  * the async checkpoint writer (its pending snapshot must be a COPY —
+    runner._snapshot_copy — or the writer-thread pull races the next
+    dispatch's buffer reuse);
+  * grouped sweep_chunk execution (per-group sub-runs each donate).
+
+The bit-identity reference is ``undonated_chunk``
+(tests/fixtures/hlocheck/bad_engines.py): the same vmap+scan semantics
+with no ``donate_argnums``.
+"""
+import dataclasses
+import pathlib
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent))
+
+from fixtures.hlocheck.bad_engines import undonated_chunk  # noqa: E402
+
+from consensus_tpu.core.config import Config  # noqa: E402
+from consensus_tpu.network import runner, simulator  # noqa: E402
+
+ADV = dict(drop_rate=0.1, partition_rate=0.05, churn_rate=0.05)
+
+# One config per engine — all six (simulator.engine_def dispatch).
+CFGS = {
+    "raft": Config(protocol="raft", n_nodes=8, n_rounds=24, n_sweeps=4,
+                   log_capacity=16, max_entries=8, **ADV),
+    "raft-sparse": Config(protocol="raft", n_nodes=16, n_rounds=24,
+                          n_sweeps=4, log_capacity=16, max_entries=8,
+                          max_active=4, **ADV),
+    "pbft": Config(protocol="pbft", f=1, n_nodes=4, n_rounds=16,
+                   n_sweeps=4, log_capacity=8, **ADV),
+    "pbft-bcast": Config(protocol="pbft", fault_model="bcast", f=5,
+                         n_nodes=16, n_rounds=16, n_sweeps=4,
+                         log_capacity=8, **ADV),
+    "paxos": Config(protocol="paxos", n_nodes=8, n_rounds=16, n_sweeps=4,
+                    log_capacity=8, **ADV),
+    "dpos": Config(protocol="dpos", n_nodes=16, n_rounds=16, n_sweeps=4,
+                   log_capacity=32, n_candidates=8, n_producers=2,
+                   epoch_len=8, **ADV),
+}
+
+
+def _assert_same(a: dict, b: dict) -> None:
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.mark.parametrize("name", sorted(CFGS))
+def test_donated_chunk_bit_identical_to_undonated(name):
+    cfg = CFGS[name]
+    eng = simulator.engine_def(cfg)
+    assert eng.name == name
+    seeds = jnp.asarray(runner.make_seeds(cfg))
+    ref = undonated_chunk(cfg, eng, cfg.n_rounds,
+                          runner._init_jit(cfg, eng, seeds), jnp.int32(0))
+    donated_in = runner._init_jit(cfg, eng, seeds)
+    out = runner._chunk_jit(cfg, eng, cfg.n_rounds, donated_in,
+                            jnp.int32(0))
+    import jax
+    for i, (a, b) in enumerate(zip(jax.tree.leaves(ref),
+                                   jax.tree.leaves(out))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"{name} leaf {i}")
+    # Donation really happened at runtime: the input buffers are gone
+    # (is_deleted is the live witness of the aliasing hlocheck pins
+    # statically).
+    assert all(leaf.is_deleted() for leaf in jax.tree.leaves(donated_in))
+
+
+@pytest.mark.parametrize("name", ["raft-sparse", "pbft-bcast"])
+def test_async_checkpoint_run_bit_identical_under_donation(name, tmp_path):
+    """The donated-buffer × async-writer interplay: the writer's pending
+    snapshot is a _snapshot_copy, so chunk k+1's buffer reuse never
+    races the background pull — results AND the written snapshot's
+    resume both stay bit-identical to the plain run."""
+    cfg = dataclasses.replace(CFGS[name], scan_chunk=6)
+    eng = simulator.engine_def(cfg)
+    base = runner.run(cfg, eng)
+    ck = tmp_path / "ck.npz"
+    ckpt = runner.run(cfg, eng, checkpoint_path=ck)         # async writer
+    _assert_same(base, ckpt)
+    sync = runner.run(cfg, eng, checkpoint_path=tmp_path / "ck2.npz",
+                      sync_checkpoints=True)
+    _assert_same(base, sync)
+    # The mid-run snapshot the writer copied out resumes bit-identically.
+    assert runner.peek_checkpoint(ck, cfg) is not None
+    resumed = runner.run(cfg, eng, checkpoint_path=ck, resume=True)
+    _assert_same(base, resumed)
+
+
+@pytest.mark.parametrize("name", ["raft-sparse", "pbft-bcast"])
+def test_sweep_chunk_groups_bit_identical_under_donation(name):
+    cfg = CFGS[name]
+    eng = simulator.engine_def(cfg)
+    base = runner.run(cfg, eng)
+    grouped = runner.run(dataclasses.replace(cfg, sweep_chunk=3), eng)
+    _assert_same(base, grouped)
+
+
+def test_telemetry_accumulator_donated_and_neutral():
+    """telem rides donate_argnums=(3, 5): accumulation is unchanged and
+    the run stays digest-neutral (tests/test_obs.py covers all engines;
+    this pins the donated-accumulator path end to end)."""
+    cfg = dataclasses.replace(CFGS["raft-sparse"], scan_chunk=6)
+    eng = simulator.engine_def(cfg)
+    base = runner.run(cfg, eng)
+    stats: dict = {}
+    telem = runner.run(cfg, eng, telemetry=True, stats=stats)
+    _assert_same(base, telem)
+    total = sum(int(v.sum()) for v in stats["telemetry"].values())
+    assert total > 0

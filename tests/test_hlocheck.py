@@ -1,0 +1,245 @@
+"""hlo-contract: the compiled-program static-analysis layer
+(tools/hlocheck — docs/STATIC_ANALYSIS.md "compiled-program layer").
+
+Three responsibilities, mirroring tests/test_static_analysis.py's
+pattern for the AST layer:
+
+  1. the CLEAN-REPO assertion: every registered (engine × flagship
+     shape × mesh) target passes all five contracts, and the committed
+     fingerprints under benchmarks/parts/fingerprints/ match what this
+     toolchain lowers today (the full gate, in-process);
+  2. SEEDED VIOLATIONS: each contract fires against a fixture engine
+     compiled through the production lowering path
+     (tests/fixtures/hlocheck/bad_engines.py) — an injected f64
+     promotion, a full-carry all-gather, a host pure_callback, a
+     sort-budget overrun, an un-donated carry;
+  3. FINGERPRINT semantics: mesh reshape (2,4)→(1,8) keeps verdicts
+     identical, --update round-trips byte-stable, and the
+     compiler-version tolerance policy (same-toolchain structural
+     drift fails, cross-toolchain drift warns, verdict drift always
+     fails).
+"""
+import copy
+import json
+import os
+import pathlib
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from fixtures.hlocheck import bad_engines  # noqa: E402
+from tools.hlocheck import __main__ as hlocheck_main  # noqa: E402
+from tools.hlocheck import contracts, fingerprint, hlo, registry  # noqa: E402
+
+FAKE_CONTRACT = contracts.EngineContract(
+    engine="fake", sort_budget=1, cumsum_budget=2, node_sharded="strict")
+
+
+def _violations(eng, mesh_shape=None, *, mode=None, axis=None,
+                jit_fn=None, contract=FAKE_CONTRACT):
+    cfg = bad_engines.CFG
+    rep = hlo.compiled_report(cfg, eng, mesh_shape, jit_fn=jit_fn)
+    return contracts.check_module(
+        rep, contract, cfg, mode=mode, axis=axis,
+        carry_leaves=hlo.n_carry_leaves(cfg, eng),
+        enforce_budgets=mesh_shape is None)
+
+
+def _contracts_hit(viols):
+    return {v.contract for v in viols}
+
+
+# --- 1. clean repo -----------------------------------------------------------
+
+@pytest.mark.skipif(
+    os.environ.get("CONSENSUS_HLO_LAYER_RAN") == "1",
+    reason="the check.py hlo layer already ran the full gate in this "
+           "`make check` invocation — don't lower all 8 targets twice")
+def test_full_gate_green_and_fingerprints_match():
+    """`python -m tools.hlocheck` (in-process): every registered target
+    passes every contract AND matches its committed fingerprint. This is
+    the tier-1 mirror of the check.py `hlo` layer (skipped under `make
+    check`, which runs the identical gate as its own layer first)."""
+    assert hlocheck_main.run_checks() == 0
+
+
+def test_every_flagship_config_has_a_committed_fingerprint():
+    from benchmarks.run_benchmarks import CONFIGS
+    names = {t.name for t in registry.targets()}
+    assert set(CONFIGS) <= names, "flagship config missing from registry"
+    for name in CONFIGS:
+        doc = fingerprint.load(name)
+        assert doc is not None, f"no committed fingerprint for {name}"
+        assert doc["schema"] == fingerprint.SCHEMA
+        for key, var in doc["variants"].items():
+            assert set(var["verdicts"].values()) == {"pass"}, (name, key)
+            # The donation satellite, statically: every carry buffer of
+            # every flagship program aliases an output.
+            assert var["donated_leaves"] == var["carry_leaves"] > 0
+
+
+def test_negative_control_fixture_passes_production_path():
+    # ok_engine through the production jit: all five contracts pass —
+    # so each bad fixture below isolates exactly its seeded violation.
+    assert _violations(bad_engines.ok_engine) == []
+
+
+# --- 2. seeded violations ----------------------------------------------------
+
+def test_injected_f64_promotion_fires_dtypes():
+    with jax.experimental.enable_x64(True):
+        viols = _violations(bad_engines.f64_engine)
+    assert "dtypes" in _contracts_hit(viols)
+    assert any("f64" in v.message for v in viols)
+    # Without the x64 flag the same source canonicalizes to f32 and the
+    # program is clean — the checker sees the COMPILED truth either way.
+    assert "dtypes" not in _contracts_hit(
+        _violations(bad_engines.f64_engine))
+
+
+def test_full_carry_all_gather_fires_collectives():
+    viols = _violations(bad_engines.gather_engine, (2, 4),
+                        mode="strict", axis="node")
+    assert "collectives" in _contracts_hit(viols)
+    assert any("full-carry" in v.message or "N, L" in v.message
+               for v in viols)
+    # The same violation also breaks the weaker "bounded" claim: a full
+    # [N, L] leaf is never O(N) metadata.
+    viols_b = _violations(bad_engines.gather_engine, (2, 4),
+                          mode="bounded", axis="node")
+    assert "collectives" in _contracts_hit(viols_b)
+
+
+def test_host_pure_callback_fires_host_boundary():
+    viols = _violations(bad_engines.callback_engine)
+    assert "host_boundary" in _contracts_hit(viols)
+    assert any("callback" in v.message for v in viols)
+
+
+def test_sort_budget_overrun_fires():
+    viols = _violations(bad_engines.sorty_engine)
+    assert "sort_budget" in _contracts_hit(viols)
+    # 2 sorts > budget 1, named in the message with the budget value.
+    assert any("> budget 1" in v.message for v in viols)
+
+
+def test_undonated_carry_fires_donation():
+    viols = _violations(bad_engines.ok_engine,
+                        jit_fn=bad_engines.undonated_chunk)
+    assert _contracts_hit(viols) == {"donation"}
+    assert any("0/2" in v.message for v in viols)
+
+
+def test_sweep_only_mesh_must_be_collective_free():
+    # The universal sweep-axis invariant, violated: gather_engine's
+    # permutation is node-local per sweep, so a sweep-only mesh is
+    # clean — but checked at mode "zero" a node-sharded gather program
+    # is not. (Guards the mode plumbing, not the engine.)
+    viols = _violations(bad_engines.gather_engine, (2, 4),
+                        mode="zero", axis="node")
+    assert "collectives" in _contracts_hit(viols)
+    assert _violations(bad_engines.gather_engine, (2,),
+                       mode="zero", axis="sweep") == []
+
+
+def test_registry_mode_stronger_than_engine_claim_rejected():
+    con = contracts.EngineContract(engine="fake", sort_budget=9,
+                                   cumsum_budget=9, node_sharded=None)
+    viols = _violations(bad_engines.ok_engine, (2, 4), mode="strict",
+                        axis="node", contract=con)
+    assert any("claims node_sharded=None" in v.message for v in viols)
+
+
+# --- 3. fingerprint semantics ------------------------------------------------
+
+def test_mesh_reshape_keeps_verdicts_identical():
+    """(2,4) → (1,8) on the canonical capped-raft target: shard sizes
+    change, contract verdicts may not (the satellite's stability
+    claim)."""
+    tgt = registry.target("raft-1k-cap8")
+    from consensus_tpu.network import simulator
+    eng = simulator.engine_def(tgt.cfg)
+    con = contracts.program_contracts()[eng.name]
+    leaves = hlo.n_carry_leaves(tgt.cfg, eng)
+    verd = {}
+    for shape in ((2, 4), (1, 8)):
+        rep = hlo.compiled_report(tgt.cfg, eng, shape)
+        viols = contracts.check_module(
+            rep, con, tgt.cfg, mode="strict", axis="node",
+            carry_leaves=leaves, enforce_budgets=False)
+        verd[shape] = contracts.verdicts(viols)
+    assert verd[(2, 4)] == verd[(1, 8)]
+    assert set(verd[(2, 4)].values()) == {"pass"}
+
+
+def test_update_roundtrips_byte_stable(tmp_path, monkeypatch):
+    monkeypatch.setattr(registry, "FINGERPRINT_DIR", tmp_path)
+    assert hlocheck_main.run_checks(only=["raft-1k-cap8"],
+                                    update=True) == 0
+    first = (tmp_path / "raft-1k-cap8.json").read_bytes()
+    assert hlocheck_main.run_checks(only=["raft-1k-cap8"],
+                                    update=True) == 0
+    assert (tmp_path / "raft-1k-cap8.json").read_bytes() == first
+    # And a freshly written fingerprint immediately verifies.
+    assert hlocheck_main.run_checks(only=["raft-1k-cap8"]) == 0
+    doc = json.loads(first)
+    assert doc["name"] == "raft-1k-cap8" and doc["variants"]
+
+
+def test_drift_policy_same_vs_cross_toolchain():
+    committed = fingerprint.load("raft-1k-cap8")
+    assert committed is not None
+    current = copy.deepcopy(committed)
+    # Structural mutation: histogram count bumps (a new fused pass).
+    var = next(iter(current["variants"]))
+    current["variants"][var]["histogram"]["elementwise"] = 99999
+    verdict_diffs, struct_diffs = fingerprint.diff(committed, current)
+    assert not verdict_diffs and struct_diffs
+    assert any("99999" in line for line in struct_diffs)
+    # Same recorded toolchain as the running one ⇒ hard failure branch.
+    assert fingerprint.same_toolchain(committed)
+    # A fingerprint recorded under another jaxlib ⇒ the warn branch.
+    foreign = copy.deepcopy(committed)
+    foreign["toolchain"] = {"jax": "9.9.9", "jaxlib": "9.9.9"}
+    assert not fingerprint.same_toolchain(foreign)
+    # Verdict mutation is caught separately and always fails.
+    current2 = copy.deepcopy(committed)
+    current2["variants"][var]["verdicts"]["donation"] = "fail"
+    verdict_diffs2, _ = fingerprint.diff(committed, current2)
+    assert verdict_diffs2
+
+
+def test_cli_rejects_unknown_target_and_lists(capsys):
+    assert hlocheck_main.run_checks(only=["no-such-target"]) == 2
+    assert hlocheck_main.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "raft-100k" in out and "node2x4" in out
+
+
+def test_update_refused_while_contracts_fail(tmp_path, monkeypatch):
+    """--update must never commit a fingerprint for a violating program
+    (the budget ceiling can only be raised by editing the engine's
+    declaration, not by regenerating artifacts)."""
+    from consensus_tpu.engines import pbft_bcast
+    monkeypatch.setattr(registry, "FINGERPRINT_DIR", tmp_path)
+    monkeypatch.setattr(
+        pbft_bcast, "PROGRAM_CONTRACT",
+        dict(pbft_bcast.PROGRAM_CONTRACT, sort_budget=0))
+    rc = hlocheck_main.run_checks(only=["pbft-100k-bcast"], update=True)
+    assert rc == 1
+    assert not (tmp_path / "pbft-100k-bcast.json").exists()
+
+
+def test_collective_census_library_matches_sizes():
+    """The generalized compiled_collectives harness: tuple-typed
+    collectives report their largest member and the capped-raft
+    canonical shape stays within the O(N) metadata bound."""
+    tgt = registry.target("raft-1k-cap8")
+    colls = hlo.compiled_collectives(tgt.cfg, (2, 4))
+    assert colls.get("all-reduce")
+    n = tgt.cfg.n_nodes
+    assert all(s <= 2 * n for s in colls.get("all-gather", []))

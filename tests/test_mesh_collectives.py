@@ -3,62 +3,36 @@
 This environment has no second chip, but it has the next best thing: the
 8-device virtual CPU mesh (conftest.py) runs the SAME GSPMD partitioner
 that places collectives on a real v5e-8, and the compiled HLO text names
-every collective it inserted. These tests lower the node-sharded round
-loop through the production path (runner._chunk_jit, the exact jit the
-benchmarks dispatch) and assert the communication *structure* the
-north-star design claims (parallel/mesh.py):
+every collective it inserted. The census harness that began here is now
+the library ``tools/hlocheck/hlo.py`` (`compiled_collectives`), which
+lowers the node-sharded round loop through the production path
+(runner._chunk_jit, the exact jit the benchmarks dispatch) — these tests
+keep the original structural claims pinned in test form, while
+``python -m tools.hlocheck`` enforces the same claims (and more) as
+per-engine contracts with committed fingerprints:
 
   * node-sharded quorum tallies become local partial sums + small
-    ALL-REDUCEs (the "quorum tallies psum'd across a device mesh"
-    design) — the collective set stays in the all-reduce/reduce-scatter
-    family;
+    ALL-REDUCEs — the collective set stays in the all-reduce family;
   * no collective ever moves a full-carry operand: the §3b sparse
     engine's only all-gathers are O(N) tracked-set metadata, never the
-    [N, L] log — a full-carry all-gather would mean GSPMD gave up on
-    the sharding and the "scales by adding chips" claim is fiction;
+    [N, L] log;
   * sweep-axis sharding is embarrassingly parallel: ZERO collectives.
 
-Numbers quoted from this census (e.g. 27 all-reduces, largest gather =
-N elements) are compiler-version-dependent; the assertions below pin
-the structural claims only.
+Numbers quoted from this census are compiler-version-dependent; the
+assertions pin the structural claims only (the fingerprint layer owns
+drift detection — tools/hlocheck/fingerprint.py).
 """
-import re
-
-import numpy as np
-import pytest
+import pathlib
+import sys
 
 from consensus_tpu.core.config import Config
-from consensus_tpu.network import runner, simulator
+from consensus_tpu.network import simulator
 from consensus_tpu.parallel.mesh import make_mesh
 
-COLLECTIVE_RE = re.compile(
-    r"= \(?([a-z0-9]+)\[([\d,]*)\][^\n]*? "
-    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)\(")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
-# The raft-100k flagship semantics (SPEC §3b capped) at a mesh-divisible
-# population — engine_def resolves this to raft_sparse, the engine whose
-# multi-chip story the benchmarks depend on.
-CAPPED = Config(protocol="raft", n_nodes=1024, n_rounds=8, n_sweeps=2,
-                log_capacity=32, max_entries=24, max_active=8, seed=6,
-                drop_rate=0.01, churn_rate=0.001)
-
-
-def compiled_collectives(cfg: Config, mesh_shape) -> dict[str, list[int]]:
-    """op name -> element counts of each collective's result operand, from
-    the compiled (post-GSPMD) HLO of one production round-loop chunk."""
-    eng = simulator.engine_def(cfg)
-    mesh = make_mesh(mesh_shape)
-    seeds = runner.make_seeds(cfg)
-    carry = runner._init_jit(cfg, eng, seeds, mesh=mesh)
-    lowered = runner._chunk_jit.lower(cfg, eng, cfg.n_rounds, carry,
-                                      np.uint32(0), mesh=mesh)
-    txt = lowered.compile().as_text()
-    out: dict[str, list[int]] = {}
-    for m in COLLECTIVE_RE.finditer(txt):
-        shape = [int(x) for x in m.group(2).split(",") if x]
-        out.setdefault(m.group(3), []).append(
-            int(np.prod(shape)) if shape else 1)
-    return out
+from tools.hlocheck.hlo import compiled_collectives  # noqa: E402
+from tools.hlocheck.registry import CAPPED_1K as CAPPED  # noqa: E402
 
 
 def test_node_sharded_capped_raft_collective_family():
@@ -102,7 +76,8 @@ def test_sweep_only_mesh_is_collective_free():
 
 def test_node_sharded_digest_matches_unsharded():
     # The census proves efficiency; this pins correctness of the very
-    # config it censused (GSPMD partitioning is digest-neutral).
+    # config it censused (GSPMD partitioning is digest-neutral — and,
+    # since the donation PR, buffer reuse across dispatches is too).
     base = simulator.run(CAPPED)
     sharded = simulator.run(CAPPED, mesh=make_mesh((2, 4)))
     assert base.digest == sharded.digest

@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""The one-command gate: lint + ruff + mypy + clang-tidy + tier-1.
+"""The one-command gate: lint + hlo + ruff + mypy + clang-tidy + tier-1.
 
     python tools/check.py [--skip-tests] [--only LAYER ...]
     make check                  # the same thing
@@ -8,6 +8,9 @@ Layers (docs/STATIC_ANALYSIS.md):
 
   lint   — tools/lint, the repo-specific determinism/parity checks
            (stdlib-only; ALWAYS runs)
+  hlo    — tools/hlocheck, the COMPILED-program contracts (collective
+           family, sort budgets, dtype widening, host boundary, carry
+           donation + fingerprints; CPU lowering only)      [gated]
   ruff   — generic Python lint (pyproject.toml)        [gated]
   mypy   — typed-perimeter type check (pyproject.toml) [gated]
   tidy   — clang-tidy over cpp/ (`make -C cpp tidy`)   [gated]
@@ -16,8 +19,9 @@ Layers (docs/STATIC_ANALYSIS.md):
 "Gated" layers SKIP with a loud notice when their tool is not
 installed — the container image bakes the jax toolchain but not
 necessarily ruff/mypy/clang-tidy; CI images that carry them enforce
-those layers too. A skip is not a pass of nothing: the always-on
-layers (lint, tests) carry the invariants that matter most.
+those layers too (the hlo layer gates on jax itself). A skip is not a
+pass of nothing: the always-on layers (lint, tests) carry the
+invariants that matter most.
 
 Exit status: nonzero iff any layer that RAN failed.
 """
@@ -51,6 +55,20 @@ def layer_lint(_: argparse.Namespace) -> str:
     return "FAIL" if _run([sys.executable, "-m", "tools.lint"]) else "ok"
 
 
+def layer_hlo(_: argparse.Namespace) -> str:
+    # tools/hlocheck self-gates (prints a loud SKIP and exits 0 when jax
+    # is missing) and forces JAX_PLATFORMS=cpu + the 8-virtual-device
+    # flags itself, so a plain subprocess is the whole layer.
+    if _run([sys.executable, "-m", "tools.hlocheck"]):
+        return "FAIL"
+    # Tell the tier-1 layer the full hlocheck gate already ran in THIS
+    # invocation: its in-process mirror test skips instead of paying the
+    # ~25 s of flagship lowering a second time (a standalone pytest run
+    # — the ROADMAP tier-1 line — still runs the mirror).
+    os.environ["CONSENSUS_HLO_LAYER_RAN"] = "1"
+    return "ok"
+
+
 def layer_ruff(_: argparse.Namespace) -> str:
     if not _have("ruff"):
         return "SKIP (ruff not installed)"
@@ -80,8 +98,8 @@ def layer_tests(args: argparse.Namespace) -> str:
     return "FAIL" if _run(TIER1, env=env) else "ok"
 
 
-LAYERS = {"lint": layer_lint, "ruff": layer_ruff, "mypy": layer_mypy,
-          "tidy": layer_tidy, "tests": layer_tests}
+LAYERS = {"lint": layer_lint, "hlo": layer_hlo, "ruff": layer_ruff,
+          "mypy": layer_mypy, "tidy": layer_tidy, "tests": layer_tests}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,22 @@
+"""hlo-contract: static analysis of the compiled programs.
+
+The AST layer (``tools/lint``) checks what the SOURCE promises; this
+package checks what the COMPILER produced — the layer where the repo's
+perf/scaling claims actually live ("stays in the all-reduce family",
+"pbft-bcast is sort-class-bound", "carry donation everywhere"). See
+``python -m tools.hlocheck --help`` and docs/STATIC_ANALYSIS.md
+("compiled-program layer").
+
+Library surface:
+
+  * :mod:`tools.hlocheck.hlo` — production-path lowering +
+    compiled-HLO parsing (:func:`hlo.compiled_report`,
+    :func:`hlo.compiled_collectives` — the generalized
+    ``tests/test_mesh_collectives.py`` harness);
+  * :mod:`tools.hlocheck.contracts` — the ``PROGRAM_CONTRACTS``
+    registry (collected from the engine modules) and the five checks;
+  * :mod:`tools.hlocheck.registry` — the (engine × flagship shape ×
+    mesh) targets;
+  * :mod:`tools.hlocheck.fingerprint` — normalized program
+    fingerprints, committed under ``benchmarks/parts/fingerprints/``.
+"""

@@ -1,0 +1,177 @@
+"""hlo-contract: static analysis of the COMPILED programs.
+
+    python -m tools.hlocheck [--update] [--only NAME ...] [--list]
+
+Lowers every registered (engine × flagship shape × mesh) config through
+the production round-loop jit on the CPU backend (trace time only — no
+simulation executes, no flagship-sized buffer is allocated) and
+enforces the per-engine ``PROGRAM_CONTRACTS``:
+
+  collectives    — all-reduce family / O(N)-bounded / zero, per the
+                   engine's declared node-sharded claim; sweep-only
+                   meshes always collective-free
+  sort_budget    — sort- and cumsum-class op counts per round, pinned
+                   to per-engine regression ceilings
+  dtypes         — no f64/s64/u64 anywhere in the lowered module
+  host_boundary  — no infeed/outfeed/host-callback custom-calls
+  donation       — every chunked-carry input buffer aliases an output
+                   (runner._chunk_jit donate_argnums)
+
+and compares a normalized program fingerprint against the committed one
+under ``benchmarks/parts/fingerprints/`` (`--update` regenerates after
+an intentional change; a contract violation is never writable). Exit
+status: nonzero on any violation, verdict drift, or same-toolchain
+structural drift. When jax is missing the gate SKIPs loudly with
+status 0, mirroring tools/check.py's gated-layer convention.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _setup_platform() -> None:
+    """CPU backend + 8 virtual devices, BEFORE the first jax import —
+    mirrors tests/conftest.py (the container's sitecustomize may force
+    the TPU plugin; lowering must never block on a tunnel)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def run_checks(only: list[str] | None = None, update: bool = False) -> int:
+    import jax
+
+    from . import contracts, fingerprint, hlo, registry
+
+    jax.config.update("jax_platforms", "cpu")
+    cons = contracts.program_contracts()
+    targets = [t for t in registry.targets()
+               if not only or t.name in only]
+    if only:
+        missing = set(only) - {t.name for t in targets}
+        if missing:
+            print(f"hlocheck: unknown target(s) {sorted(missing)}; known: "
+                  f"{[t.name for t in registry.targets()]}", file=sys.stderr)
+            return 2
+    need_mesh = any(v.mesh_shape for t in targets for v in t.variants)
+    if need_mesh and len(jax.devices()) < 8:
+        print("hlocheck: FAIL — mesh variants need 8 virtual devices; run "
+              "with JAX_PLATFORMS=cpu XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8 (or via "
+              "`python -m tools.hlocheck`, which sets both)",
+              file=sys.stderr)
+        return 1
+
+    rc = 0
+    for tgt in targets:
+        from consensus_tpu.network import simulator
+        eng = simulator.engine_def(tgt.cfg)
+        con = cons[eng.name]
+        leaves = hlo.n_carry_leaves(tgt.cfg, eng)
+        variants: dict[str, dict] = {}
+        bad = False
+        for var in tgt.variants:
+            t0 = time.perf_counter()
+            rep = hlo.compiled_report(tgt.cfg, eng, var.mesh_shape)
+            viol = contracts.check_module(
+                rep, con, tgt.cfg, mode=var.mode, axis=var.axis,
+                carry_leaves=leaves,
+                enforce_budgets=var.mesh_shape is None)
+            verd = contracts.verdicts(viol)
+            variants[var.key] = fingerprint.variant_entry(
+                var, rep, verd, leaves)
+            wall = time.perf_counter() - t0
+            status = "ok" if not viol else "FAIL"
+            print(f"hlocheck: {tgt.name}/{var.key:8s} [{eng.name}] "
+                  f"{status}  ({wall:.1f}s, sort={rep.sort_ops} "
+                  f"cumsum={rep.cumsum_ops} donated={len(rep.donation)}/"
+                  f"{leaves})", flush=True)
+            for v in viol:
+                print(f"hlocheck:   {v}", flush=True)
+                bad = True
+        doc = fingerprint.build(tgt, eng.name, variants)
+        if bad:
+            rc = 1
+            if update:
+                print(f"hlocheck: {tgt.name}: NOT updating fingerprint — "
+                      f"contracts must pass first", flush=True)
+            continue
+        committed = fingerprint.load(tgt.name)
+        if update:
+            path = fingerprint.save(doc)
+            print(f"hlocheck: {tgt.name}: fingerprint written -> {path}",
+                  flush=True)
+            continue
+        if committed is None:
+            print(f"hlocheck: {tgt.name}: FAIL — no committed fingerprint "
+                  f"({fingerprint.path_for(tgt.name)}); run "
+                  f"`python -m tools.hlocheck --update` and commit it",
+                  flush=True)
+            rc = 1
+            continue
+        verdict_diffs, struct_diffs = fingerprint.diff(committed, doc)
+        if verdict_diffs:
+            print(f"hlocheck: {tgt.name}: FAIL — contract VERDICTS drifted "
+                  f"from the committed fingerprint:", flush=True)
+            for line in verdict_diffs:
+                print(line, flush=True)
+            rc = 1
+        if struct_diffs:
+            if fingerprint.same_toolchain(committed):
+                print(f"hlocheck: {tgt.name}: FAIL — structural drift vs "
+                      f"committed fingerprint (same toolchain ⇒ a code "
+                      f"change; rerun with --update if intentional):",
+                      flush=True)
+                rc = 1
+            else:
+                print(f"hlocheck: {tgt.name}: WARNING — structural drift "
+                      f"under a DIFFERENT jax/jaxlib; op-count churn is "
+                      f"expected across compilers (verdicts above are the "
+                      f"enforced layer). Diff:", flush=True)
+            for line in struct_diffs:
+                print(line, flush=True)
+    print(f"hlocheck: {'FAILED' if rc else 'ok'} "
+          f"({len(targets)} targets)", flush=True)
+    return rc
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hlocheck",
+        description="Compiled-program contract analyzer "
+                    "(docs/STATIC_ANALYSIS.md, compiled-program layer).")
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate the committed fingerprints (refused "
+                         "while any contract fails)")
+    ap.add_argument("--only", action="append", default=None,
+                    help="check only this target (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered targets and variants")
+    args = ap.parse_args(argv)
+
+    if "jax" not in sys.modules:
+        _setup_platform()
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        print("hlocheck: SKIP — jax is not installed; the compiled-"
+              "program contracts need the CPU backend to lower against "
+              "(install jax[cpu] to enforce this layer)", file=sys.stderr)
+        return 0
+
+    if args.list:
+        from . import registry
+        for t in registry.targets():
+            keys = ", ".join(v.key for v in t.variants)
+            print(f"{t.name:18s} [{keys}]")
+        return 0
+    return run_checks(only=args.only, update=args.update)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,258 @@
+"""Per-engine compiled-program contracts (the `PROGRAM_CONTRACTS` registry).
+
+Each engine module declares, next to its kernel, the budgets and claims
+its COMPILED program must satisfy:
+
+    PROGRAM_CONTRACT = dict(
+        sort_budget=3,      # max sort-class ops per round program
+        cumsum_budget=33,   # max cumsum-class (reduce-window) ops
+        node_sharded=None,  # None | "zero" | "bounded" | "strict"
+    )
+
+Budgets are regression CEILINGS: the ROADMAP sort-diet work may lower
+them (then lower the declaration in the same commit), never raise them
+— a new sort pass slipping into a round fails the gate at trace time on
+CPU, not three benchmark rounds later on a tunnel chip.
+
+``node_sharded`` is the strongest structural claim the engine makes for
+programs whose NODE axis is sharded:
+
+  * ``"strict"``  — collective set ⊆ {all-reduce, all-gather,
+    reduce-scatter}, an all-reduce present (the quorum psum actually
+    crosses the mesh), all-gathers O(N) metadata, and nothing in the
+    [N, L] full-carry class. The capped-raft multi-chip story.
+  * ``"bounded"`` — any collective family (distributed sorts legally
+    emit all-to-all / collective-permute at flagship N), but every
+    collective operand stays O(N) — bounded by
+    ``collective_elems_per_node * N`` and far below the [N, L] carry.
+  * ``"zero"``    — no collectives at all (dpos: its carry has no
+    node-indexed leaf).
+  * ``None``      — no claim yet: the engine's multi-chip story is
+    unproven and hlocheck registers no node-sharded variant for it.
+    This is the gate the hierarchical-engine / mesh-scaling refactors
+    land behind: flipping an engine's claim from None requires its
+    compiled program to actually satisfy the declared mode.
+
+Sweep-only sharding is NOT per-engine: sweeps are independent
+simulators, so every engine must compile to ZERO collectives on a
+sweep-only mesh (checked unconditionally wherever the flagship shape
+permits one).
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+
+from . import hlo
+
+CONTRACT_NAMES = ("collectives", "sort_budget", "dtypes",
+                  "host_boundary", "donation")
+
+_ENGINE_MODULES = ("raft", "raft_sparse", "pbft", "pbft_bcast",
+                   "paxos", "dpos")
+
+_MODES = (None, "zero", "bounded", "strict")
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineContract:
+    engine: str
+    sort_budget: int
+    cumsum_budget: int
+    node_sharded: str | None
+    # "bounded"/"strict" size cap, in units of n_nodes: a collective may
+    # move O(N) metadata (fused gathers reach a few N at flagship
+    # shapes), never the [N, log_capacity] carry.
+    collective_elems_per_node: int = 8
+    custom_call_allow: tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if self.node_sharded not in _MODES:
+            raise ValueError(f"{self.engine}: node_sharded="
+                             f"{self.node_sharded!r} not in {_MODES}")
+
+    def allows_mode(self, mode: str) -> bool:
+        """May a node-sharded variant be checked at ``mode``? The claim
+        is the strongest mode; "strict" implies "bounded"."""
+        if self.node_sharded == mode:
+            return True
+        return self.node_sharded == "strict" and mode == "bounded"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    contract: str   # one of CONTRACT_NAMES
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.contract}] {self.message}"
+
+
+def program_contracts() -> dict[str, EngineContract]:
+    """EngineDef name -> declared contract, collected from the engine
+    modules (the declaration lives next to the kernel it constrains)."""
+    out: dict[str, EngineContract] = {}
+    for name in _ENGINE_MODULES:
+        mod = importlib.import_module(f"consensus_tpu.engines.{name}")
+        eng = mod.get_engine()
+        out[eng.name] = EngineContract(engine=eng.name,
+                                       **mod.PROGRAM_CONTRACT)
+    return out
+
+
+def _check_collectives(rep: hlo.ModuleReport, con: EngineContract,
+                       mode: str | None, axis: str | None,
+                       cfg) -> list[Violation]:
+    out: list[Violation] = []
+    if mode is None:                     # single-device program
+        if rep.collectives:
+            out.append(Violation(
+                "collectives",
+                f"single-device program emitted collectives: "
+                f"{sorted(rep.collectives)}"))
+        return out
+    if axis == "node" and not con.allows_mode(mode):
+        out.append(Violation(
+            "collectives",
+            f"engine {con.engine} claims node_sharded="
+            f"{con.node_sharded!r}; a variant checked it at {mode!r}"))
+        return out
+    if mode == "zero":
+        if rep.collectives:
+            out.append(Violation(
+                "collectives",
+                f"expected a collective-free program, got "
+                f"{ {k: len(v) for k, v in rep.collectives.items()} }"))
+        return out
+    # "bounded" / "strict": ONE effective size cap — the tighter of the
+    # O(N)-metadata allowance and an 8× margin below the [N, L]
+    # full-carry leaf (a collective approaching the leaf is the
+    # partitioner giving up on the sharding, whatever the op). Merged
+    # into a single check so the verdict and its message agree about
+    # which bound binds at this config's log_capacity.
+    n, full_leaf = cfg.n_nodes, cfg.n_nodes * cfg.log_capacity
+    cap = min(con.collective_elems_per_node * n, full_leaf // 8)
+    for op, sizes in rep.collectives.items():
+        worst = max(sizes)
+        if worst > cap:
+            out.append(Violation(
+                "collectives",
+                f"{op} moves {worst} elements > cap {cap} "
+                f"(= min({con.collective_elems_per_node}*N "
+                f"= {con.collective_elems_per_node * n}, "
+                f"[N, L]/8 = {full_leaf // 8})) — more than O(N) "
+                f"metadata{' / full-carry-class traffic' if 8 * worst > full_leaf else ''}"))
+    if mode == "strict":
+        allowed = {"all-reduce", "all-gather", "reduce-scatter"}
+        extra = set(rep.collectives) - allowed
+        if extra:
+            out.append(Violation(
+                "collectives",
+                f"outside the all-reduce family: {sorted(extra)}"))
+        if "all-reduce" not in rep.collectives:
+            out.append(Violation(
+                "collectives",
+                "no all-reduce: the partitioner replicated the state "
+                "and the mesh is decorative"))
+        gathers = rep.collectives.get("all-gather", ())
+        if gathers and max(gathers) > 2 * n:
+            out.append(Violation(
+                "collectives",
+                f"all-gather of {max(gathers)} elements > 2N={2 * n} — "
+                f"more than O(N) tracked-set metadata"))
+    return out
+
+
+def _check_sort_budget(rep: hlo.ModuleReport,
+                       con: EngineContract) -> list[Violation]:
+    out = []
+    if rep.sort_ops > con.sort_budget:
+        out.append(Violation(
+            "sort_budget",
+            f"{rep.sort_ops} sort-class ops > budget {con.sort_budget} "
+            f"(engine {con.engine}; budgets only ever go down)"))
+    if rep.cumsum_ops > con.cumsum_budget:
+        out.append(Violation(
+            "sort_budget",
+            f"{rep.cumsum_ops} cumsum-class ops > budget "
+            f"{con.cumsum_budget} (engine {con.engine})"))
+    return out
+
+
+def _check_dtypes(rep: hlo.ModuleReport) -> list[Violation]:
+    if rep.wide_dtypes:
+        return [Violation(
+            "dtypes",
+            f"64-bit types in the lowered module: "
+            f"{list(rep.wide_dtypes)} — an implicit promotion the AST "
+            f"lint cannot see (u32/i32 discipline, docs/SPEC.md)")]
+    return []
+
+
+def _check_host_boundary(rep: hlo.ModuleReport,
+                         con: EngineContract) -> list[Violation]:
+    out = []
+    if rep.host_ops:
+        out.append(Violation(
+            "host_boundary",
+            f"host-transfer ops in a device program: {list(rep.host_ops)}"))
+    bad = [t for t in rep.custom_call_targets
+           if t not in con.custom_call_allow
+           and hlo.HOST_CALLBACK_RE.search(t)]
+    unknown = [t for t in rep.custom_call_targets
+               if t not in con.custom_call_allow
+               and not hlo.HOST_CALLBACK_RE.search(t)]
+    if bad:
+        out.append(Violation(
+            "host_boundary",
+            f"host-callback custom-calls: {bad} (pure_callback/"
+            f"io_callback class — a host round-trip per round)"))
+    if unknown:
+        out.append(Violation(
+            "host_boundary",
+            f"undeclared custom-call targets: {unknown} — allow-list "
+            f"them in the engine's PROGRAM_CONTRACT if intentional"))
+    return out
+
+
+def _check_donation(rep: hlo.ModuleReport, leaves: int) -> list[Violation]:
+    donated = sorted(p for _, p in rep.donation)
+    if donated != list(range(leaves)):
+        return [Violation(
+            "donation",
+            f"carry not (fully) donated: {len(donated)}/{leaves} input "
+            f"buffers aliased (params {donated[:8]}{'...' if len(donated) > 8 else ''}) "
+            f"— the chunked carry must reuse its buffers across "
+            f"dispatches (runner._chunk_jit donate_argnums)")]
+    return []
+
+
+def check_module(rep: hlo.ModuleReport, con: EngineContract, cfg, *,
+                 mode: str | None, axis: str | None,
+                 carry_leaves: int,
+                 enforce_budgets: bool = True) -> list[Violation]:
+    """Evaluate all five contracts against one compiled module.
+
+    ``mode``/``axis`` describe the variant (None = single device;
+    axis "sweep" or "node" for meshed ones). ``enforce_budgets`` is off
+    for meshed variants: the partitioner legitimately splits one logical
+    sort into per-shard sort + merge passes, so budgets pin the
+    single-device program the benchmarks dispatch (mesh counts are still
+    recorded in the fingerprint).
+    """
+    out = _check_collectives(rep, con, mode, axis, cfg)
+    if enforce_budgets:
+        out += _check_sort_budget(rep, con)
+    out += _check_dtypes(rep)
+    out += _check_host_boundary(rep, con)
+    out += _check_donation(rep, carry_leaves)
+    return out
+
+
+def verdicts(violations: list[Violation]) -> dict[str, str]:
+    """Per-contract pass/fail map — the compiler-version-TOLERANT layer
+    of the fingerprint (op counts may drift across XLA versions; these
+    may not)."""
+    failed = {v.contract for v in violations}
+    return {name: ("fail" if name in failed else "pass")
+            for name in CONTRACT_NAMES}

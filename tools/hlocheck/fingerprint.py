@@ -1,0 +1,121 @@
+"""Program fingerprints: normalized compiled-program summaries, committed
+under ``benchmarks/parts/fingerprints/`` and diffed on every check run.
+
+A fingerprint is NOT the HLO text (instruction names, ids and layouts
+churn with every compiler release); it is the structure the repo's perf
+and scaling claims actually rest on:
+
+  * the op-CLASS histogram (sort / cumsum / collective / gather /
+    scatter / reduce / elementwise / data / control — coarse buckets
+    survive fusion-decision churn),
+  * the collective census (op -> count + largest operand element count),
+  * the donation map size (how many carry buffers alias),
+  * the per-variant contract verdicts.
+
+Tolerance policy: verdict drift ALWAYS fails (the verdicts are the
+compiler-version-tolerant layer — a contract that passed must keep
+passing on any toolchain). Structural drift (histogram, censuses,
+budgets' exact values) fails when the recorded jax/jaxlib version pair
+matches the running one — same compiler, same program, so any diff is a
+code change that must be intentional (`--update`) — and downgrades to a
+LOUD warning across compiler versions, where op-count churn is expected.
+Files are written with sorted keys and a trailing newline so `--update`
+round-trips byte-stable.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+
+from . import hlo, registry
+
+SCHEMA = 1
+
+
+def path_for(name: str) -> pathlib.Path:
+    return registry.FINGERPRINT_DIR / f"{name}.json"
+
+
+def _jax_versions() -> dict[str, str]:
+    import jax
+    import jaxlib
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+
+
+def variant_entry(variant, rep: hlo.ModuleReport, verdicts: dict[str, str],
+                  carry_leaves: int) -> dict:
+    return {
+        "mesh": list(variant.mesh_shape) if variant.mesh_shape else None,
+        "mode": variant.mode,
+        "verdicts": dict(sorted(verdicts.items())),
+        "histogram": rep.histogram(),
+        "collectives": {op: {"count": len(sizes),
+                             "max_elems": max(sizes)}
+                        for op, sizes in sorted(rep.collectives.items())},
+        "sort_ops": rep.sort_ops,
+        "cumsum_ops": rep.cumsum_ops,
+        "donated_leaves": len(rep.donation),
+        "carry_leaves": carry_leaves,
+        "wide_dtypes": list(rep.wide_dtypes),
+        "custom_calls": list(rep.custom_call_targets),
+    }
+
+
+def build(target, engine_name: str, variants: dict[str, dict]) -> dict:
+    return {
+        "schema": SCHEMA,
+        "name": target.name,
+        "engine": engine_name,
+        "chunk_rounds": hlo.chunk_rounds(target.cfg),
+        "toolchain": _jax_versions(),
+        "config": json.loads(target.cfg.to_json()),
+        "variants": dict(sorted(variants.items())),
+    }
+
+
+def save(doc: dict) -> pathlib.Path:
+    path = path_for(doc["name"])
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    return path
+
+
+def load(name: str) -> dict | None:
+    path = path_for(name)
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def _walk_diff(prefix: str, old, new, out: list[str]) -> None:
+    if isinstance(old, dict) and isinstance(new, dict):
+        for k in sorted(set(old) | set(new)):
+            _walk_diff(f"{prefix}.{k}" if prefix else str(k),
+                       old.get(k), new.get(k), out)
+    elif old != new:
+        out.append(f"  {prefix}: {old!r} -> {new!r}")
+
+
+def diff(committed: dict, current: dict) -> tuple[list[str], list[str]]:
+    """(verdict_diffs, structural_diffs) between a committed fingerprint
+    and a freshly computed one. Toolchain and schema fields are compared
+    as structure (an intentional jax upgrade re-records them via
+    --update)."""
+    verdicts: list[str] = []
+    structure: list[str] = []
+    for key in sorted(set(committed.get("variants", {}))
+                      | set(current.get("variants", {}))):
+        old = committed.get("variants", {}).get(key, {})
+        new = current.get("variants", {}).get(key, {})
+        _walk_diff(f"variants.{key}.verdicts",
+                   old.get("verdicts"), new.get("verdicts"), verdicts)
+        for field in sorted((set(old) | set(new)) - {"verdicts"}):
+            _walk_diff(f"variants.{key}.{field}",
+                       old.get(field), new.get(field), structure)
+    for field in ("schema", "engine", "chunk_rounds", "config", "toolchain"):
+        _walk_diff(field, committed.get(field), current.get(field), structure)
+    return verdicts, structure
+
+
+def same_toolchain(committed: dict) -> bool:
+    return committed.get("toolchain") == _jax_versions()

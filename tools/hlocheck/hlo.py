@@ -1,0 +1,214 @@
+"""Lowering + compiled-HLO parsing for the program-contract analyzer.
+
+This is the ``compiled_collectives`` harness of
+``tests/test_mesh_collectives.py`` generalized into a library: lower a
+config through the PRODUCTION round-loop jit (``runner._chunk_jit``, the
+exact program the benchmarks dispatch), compile it on the CPU backend
+(same GSPMD partitioner a real v5e runs — trace time only, zero FLOPs,
+no 100k-node buffer is ever allocated thanks to ``jax.eval_shape``),
+and parse the post-optimization HLO text into a structured
+:class:`ModuleReport`: opcode census, collective census with operand
+sizes, 64-bit dtype occurrences, host-boundary ops, and the
+``input_output_alias`` donation map.
+
+Counting note: the chunk program is ONE ``while`` loop whose body is the
+round kernel (plus a fixed init/epilogue), so module-wide op counts ARE
+per-round counts for the round-body op classes (sort/cumsum/collective)
+— XLA never unrolls the scan at these lengths (see ``_chunk_jit``'s
+docstring for why a length-1 chunk scans a masked pair instead).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections import Counter
+
+# --- compiled-text parsing (pure string work; no jax needed) -----------------
+
+# One HLO instruction: `%name = <result-type> opcode(...)`. The result
+# type may be a tuple with spaces — `(s32[4,8]{1,0}, u32[8]{0})` — so the
+# type segment is matched non-greedily up to the opcode token, which is
+# the first `word(` after the `=` (types never contain `(` outside the
+# tuple wrapper, and metadata comes after the operand list).
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT )?%[\w.\-]+ = (.*?) ([a-z][\w\-]*)\(", re.M)
+_SHAPE_RE = re.compile(r"\b([a-z0-9]+)\[([\d,]*)\]")
+_WIDE_RE = re.compile(r"\b(f64|s64|u64|c128)\[")
+_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
+# `input_output_alias={ {0}: (0, {}, may-alias), ... }` in the module
+# header: output tuple index -> donated entry-parameter index.
+_ALIAS_RE = re.compile(
+    r"\{([\d,]*)\}:\s*\((\d+),\s*\{[\d,]*\},?\s*(?:may|must)-alias\)")
+
+SORT_OPS = frozenset({"sort"})
+# The engines' only reduce-window producers are the cumulative ops
+# (cumsum/cummax/cummin brackets — docs/PERF.md "sort diet"); top-k has
+# no custom-call lowering here and lands in the sort class.
+CUMSUM_OPS = frozenset({"reduce-window"})
+COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast"})
+HOST_OPS = frozenset({"infeed", "outfeed", "send", "recv",
+                      "send-done", "recv-done"})
+# Host-callback custom-call targets (jax.pure_callback / io_callback /
+# debug prints): any target matching this is a host round-trip inside
+# what must be a pure device program.
+HOST_CALLBACK_RE = re.compile(r"callback|host|py_func", re.I)
+
+# Coarse op classes for the normalized fingerprint histogram: buckets
+# are stable across compiler versions even when fusion decisions move
+# individual elementwise ops around.
+_CLASS_PATTERNS: tuple[tuple[str, frozenset[str]], ...] = (
+    ("sort", SORT_OPS),
+    ("cumsum", CUMSUM_OPS),
+    ("collective", COLLECTIVE_OPS),
+    ("host", HOST_OPS),
+    ("custom-call", frozenset({"custom-call"})),
+    ("gather", frozenset({"gather", "dynamic-slice"})),
+    ("scatter", frozenset({"scatter", "dynamic-update-slice"})),
+    ("reduce", frozenset({"reduce", "reduce-precision"})),
+    ("rng", frozenset({"rng", "rng-bit-generator", "rng-get-and-update-state"})),
+    ("control", frozenset({"while", "conditional", "call", "fusion"})),
+    ("data", frozenset({
+        "parameter", "constant", "iota", "broadcast", "reshape",
+        "transpose", "slice", "pad", "concatenate", "convert",
+        "bitcast", "bitcast-convert", "copy", "copy-start", "copy-done",
+        "tuple", "get-tuple-element", "domain", "after-all",
+        "optimization-barrier"})),
+)
+
+
+def op_class(op: str) -> str:
+    for cls, ops in _CLASS_PATTERNS:
+        if op in ops:
+            return cls
+    return "elementwise"
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuleReport:
+    """Everything the contracts need from one compiled module."""
+    ops: dict[str, int]                       # raw opcode -> count
+    collectives: dict[str, tuple[int, ...]]   # op -> per-instr max elems
+    wide_dtypes: tuple[str, ...]              # f64/s64/u64/c128 seen
+    host_ops: tuple[str, ...]                 # infeed/outfeed/send/recv hits
+    custom_call_targets: tuple[str, ...]      # every custom-call target
+    donation: tuple[tuple[int, int], ...]     # (output index, param index)
+
+    @property
+    def sort_ops(self) -> int:
+        return sum(n for op, n in self.ops.items() if op in SORT_OPS)
+
+    @property
+    def cumsum_ops(self) -> int:
+        return sum(n for op, n in self.ops.items() if op in CUMSUM_OPS)
+
+    def histogram(self) -> dict[str, int]:
+        out: Counter = Counter()
+        for op, n in self.ops.items():
+            out[op_class(op)] += n
+        return dict(sorted(out.items()))
+
+
+def _max_elems(type_segment: str) -> int:
+    """Largest element count among a result type's (possibly tuple)
+    array members — the size a bound on "what this op moves" must see."""
+    best = 1
+    for m in _SHAPE_RE.finditer(type_segment):
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n)
+    return best
+
+
+def analyze(txt: str) -> ModuleReport:
+    """Parse one compiled module's text into a :class:`ModuleReport`."""
+    ops: Counter = Counter()
+    collectives: dict[str, list[int]] = {}
+    host_ops: list[str] = []
+    for m in _INSTR_RE.finditer(txt):
+        type_seg, op = m.group(1), m.group(2)
+        ops[op] += 1
+        if op in COLLECTIVE_OPS:
+            collectives.setdefault(op, []).append(_max_elems(type_seg))
+        if op in HOST_OPS:
+            host_ops.append(op)
+    header = txt.splitlines()[0] if txt else ""
+    donation = tuple(
+        (int(m.group(1).split(",")[0] or 0), int(m.group(2)))
+        for m in _ALIAS_RE.finditer(header))
+    return ModuleReport(
+        ops=dict(sorted(ops.items())),
+        collectives={k: tuple(sorted(v)) for k, v in
+                     sorted(collectives.items())},
+        wide_dtypes=tuple(sorted(set(_WIDE_RE.findall(txt)))),
+        host_ops=tuple(sorted(host_ops)),
+        custom_call_targets=tuple(sorted(set(_TARGET_RE.findall(txt)))),
+        donation=donation)
+
+
+# --- production-path lowering (imports jax lazily) ---------------------------
+
+def carry_struct(cfg, eng):
+    """ShapeDtypeStruct pytree of the batched carry via ``eval_shape`` —
+    no buffer is ever allocated, so 100k-node configs are safe on any
+    host."""
+    import jax
+    import jax.numpy as jnp
+    seeds = jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32)
+    return jax.eval_shape(
+        lambda s: jax.vmap(lambda x: eng.make_carry(cfg, x))(s), seeds)
+
+
+def chunk_rounds(cfg) -> int:
+    """The round count of the production chunk program (`runner.run`'s
+    chunking rule without the checkpoint-implied split)."""
+    return cfg.scan_chunk or cfg.n_rounds
+
+
+def n_carry_leaves(cfg, eng) -> int:
+    import jax
+    return len(jax.tree.leaves(carry_struct(cfg, eng)))
+
+
+def compiled_text(cfg, eng=None, mesh_shape=None, *, jit_fn=None,
+                  mesh=None) -> str:
+    """Compiled (post-GSPMD, post-optimization) HLO text of one
+    production round-loop chunk: ``runner._chunk_jit.lower(...)
+    .compile().as_text()`` over eval_shape structs — trace time only.
+
+    ``jit_fn`` substitutes another jit with the same signature (the
+    un-donated fixture twin); ``mesh`` passes a prebuilt Mesh (fixtures
+    close over one), else ``mesh_shape`` builds it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_tpu.network import runner, simulator
+    from consensus_tpu.parallel import mesh as meshlib
+    if eng is None:
+        eng = simulator.engine_def(cfg)
+    if mesh is None and mesh_shape:
+        mesh = meshlib.make_mesh(mesh_shape)
+    carry = carry_struct(cfg, eng)
+    r0 = jax.ShapeDtypeStruct((), jnp.int32)
+    fn = jit_fn if jit_fn is not None else runner._chunk_jit
+    lowered = fn.lower(cfg, eng, chunk_rounds(cfg), carry, r0, mesh=mesh)
+    return lowered.compile().as_text()
+
+
+def compiled_report(cfg, eng=None, mesh_shape=None, *, jit_fn=None,
+                    mesh=None) -> ModuleReport:
+    return analyze(compiled_text(cfg, eng, mesh_shape, jit_fn=jit_fn,
+                                 mesh=mesh))
+
+
+def compiled_collectives(cfg, mesh_shape, eng=None) -> dict[str, list[int]]:
+    """op name -> element counts of each collective's result operand —
+    the original ``tests/test_mesh_collectives.py`` harness, now served
+    by the shared parser (tuple-typed collectives report their LARGEST
+    member, a strictly tighter reading than the old first-member one)."""
+    rep = compiled_report(cfg, eng, mesh_shape)
+    return {k: list(v) for k, v in rep.collectives.items()}

@@ -1,0 +1,99 @@
+"""The (engine × flagship shape × mesh) configs hlocheck lowers.
+
+Flagship shapes come straight from ``benchmarks/run_benchmarks.CONFIGS``
+(one source of truth — a benchmark shape change re-fingerprints
+automatically), plus two canonical non-flagship targets:
+
+  * ``raft-1k-cap8`` — the §3b capped engine at the mesh-divisible
+    population ``tests/test_mesh_collectives.py`` established, where the
+    STRICT all-reduce-family claim holds. Checked under both (2, 4) and
+    (1, 8) meshes: reshaping the mesh must not change any verdict.
+  * ``pbft-1k-dense`` — the dense §6 engine (no flagship config of its
+    own; the 100k row is the §6b bcast engine), so its sort budget and
+    donation are still pinned.
+
+Variant axes per target:
+
+  * ``single``  — no mesh: the exact program the benchmarks dispatch.
+    All five contracts enforced, budgets included.
+  * ``sweep8``  — sweep-only (8,) mesh: must compile to ZERO
+    collectives (sweeps are independent simulators). Registered
+    wherever 8 divides the flagship sweep count.
+  * node-sharded variants — only for engines whose PROGRAM_CONTRACT
+    claims one (docs/STATIC_ANALYSIS.md "compiled-program layer"):
+    raft-sparse at "strict" (canonical shape) and "bounded" (flagship
+    100k, where distributed sorts legally add all-to-all but stay
+    O(N)); dpos at "zero".
+"""
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from benchmarks.run_benchmarks import CONFIGS as FLAGSHIP_CONFIGS  # noqa: E402
+from consensus_tpu.core.config import Config  # noqa: E402
+
+FINGERPRINT_DIR = _REPO / "benchmarks" / "parts" / "fingerprints"
+
+ADV = dict(drop_rate=0.01, churn_rate=0.001)
+
+
+@dataclasses.dataclass(frozen=True)
+class Variant:
+    key: str
+    mesh_shape: tuple[int, ...] | None
+    mode: str | None        # collective mode (None = single device)
+    axis: str | None = None  # "sweep" | "node" for meshed variants
+
+
+@dataclasses.dataclass(frozen=True)
+class Target:
+    name: str
+    cfg: Config
+    variants: tuple[Variant, ...]
+
+
+SINGLE = Variant("single", None, None)
+SWEEP8 = Variant("sweep8", (8,), "zero", "sweep")
+
+# The canonical capped-raft shape of tests/test_mesh_collectives.py —
+# the population where the strict family claim is established.
+CAPPED_1K = Config(protocol="raft", n_nodes=1024, n_rounds=8, n_sweeps=2,
+                   log_capacity=32, max_entries=24, max_active=8, seed=6,
+                   **ADV)
+
+PBFT_1K_DENSE = Config(protocol="pbft", f=341, n_nodes=1024, n_rounds=32,
+                       n_sweeps=2, log_capacity=16, seed=3, **ADV)
+
+
+def targets() -> tuple[Target, ...]:
+    F = FLAGSHIP_CONFIGS
+    return (
+        Target("raft-5node", F["raft-5node"], (SINGLE, SWEEP8)),
+        Target("raft-1kx1k", F["raft-1kx1k"], (SINGLE, SWEEP8)),
+        Target("raft-100k", F["raft-100k"],
+               (SINGLE, Variant("node2x4", (2, 4), "bounded", "node"),
+                SWEEP8)),
+        Target("pbft-100k-bcast", F["pbft-100k-bcast"], (SINGLE, SWEEP8)),
+        Target("paxos-10kx10k", F["paxos-10kx10k"], (SINGLE,)),
+        Target("dpos-100k", F["dpos-100k"],
+               (SINGLE, Variant("node1x8", (1, 8), "zero", "node"))),
+        Target("raft-1k-cap8", CAPPED_1K,
+               (SINGLE,
+                Variant("node2x4", (2, 4), "strict", "node"),
+                Variant("node1x8", (1, 8), "strict", "node"))),
+        Target("pbft-1k-dense", PBFT_1K_DENSE, (SINGLE,)),
+    )
+
+
+def target(name: str) -> Target:
+    for t in targets():
+        if t.name == name:
+            return t
+    raise KeyError(f"unknown hlocheck target {name!r}; "
+                   f"known: {[t.name for t in targets()]}")
