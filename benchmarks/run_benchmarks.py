@@ -330,6 +330,28 @@ def _progress(row: dict) -> None:
           file=sys.stderr, flush=True)
 
 
+def stale_rows(doc: dict) -> list[tuple[str, str]]:
+    """(name, note) for every row carrying a ``stale_timing`` marker —
+    a committed measurement known to predate a timing or kernel fix
+    (the pbft-100k-bcast row predates both the repeat-scan fix and the
+    sort-diet round). A fresh measurement of the config naturally drops
+    the marker (the row is rebuilt); until then every reader of the
+    file is warned up front."""
+    return [(row["name"], row["stale_timing"])
+            for row in doc.get("rows", []) if row.get("stale_timing")]
+
+
+def warn_stale(path: pathlib.Path) -> None:
+    if not path.exists():
+        return
+    try:
+        doc = json.loads(path.read_text())
+    except ValueError:
+        return
+    for name, note in stale_rows(doc):
+        print(f"  STALE ROW {name}: {note}", file=sys.stderr, flush=True)
+
+
 def backfill_bandwidth(path: pathlib.Path) -> int:
     """Add the achieved-bandwidth column to existing RESULTS rows from
     their recorded config + wall (pure arithmetic over the state schema
@@ -372,11 +394,13 @@ def main() -> None:
                          "probe; see consensus_tpu.utils.platform)")
     args = ap.parse_args()
 
+    out_path = pathlib.Path(args.out) if args.out else \
+        pathlib.Path(__file__).parent / "RESULTS.json"
+    warn_stale(out_path)
+
     if args.backfill_bandwidth:
-        path = pathlib.Path(args.out) if args.out else \
-            pathlib.Path(__file__).parent / "RESULTS.json"
-        n = backfill_bandwidth(path)
-        print(f"bandwidth column backfilled on {n} rows in {path}",
+        n = backfill_bandwidth(out_path)
+        print(f"bandwidth column backfilled on {n} rows in {out_path}",
               file=sys.stderr)
         return
 
@@ -422,8 +446,6 @@ def main() -> None:
             fs = PBFT_FS[:4] if args.quick else PBFT_FS
             results["rows"] += bench_pbft_oracle_ladder(fs)
 
-    out_path = pathlib.Path(args.out) if args.out else \
-        pathlib.Path(__file__).parent / "RESULTS.json"
     out_path.write_text(json.dumps(results, indent=2))
     print(f"wrote {out_path}", file=sys.stderr)
 

@@ -171,10 +171,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--f-sweep", default="",
                     help="pbft + tpu engine only: run a whole f ladder "
                          "('1..128' or '1,2,4') as ONE compiled padded "
-                         "program (engines/pbft_sweep.py); element k uses "
-                         "f=fs[k], seed=seed+k. Reports real-node steps/sec "
-                         "+ the digest of the concatenated per-f canonical "
-                         "payloads (byte-equal to running each f alone)")
+                         "program (engines/pbft_sweep.py), under either "
+                         "fault model (--fault-model bcast runs the §6b "
+                         "aggregate round with traced per-rung f) and "
+                         "with --sweeps K independent instances per rung; "
+                         "rung k sweep j uses f=fs[k], seed=seed+k+j. "
+                         "Reports real-node steps/sec + per-rung digests "
+                         "and the digest of the concatenated per-rung "
+                         "canonical payloads (byte-equal to running each "
+                         "f alone)")
     return ap
 
 
@@ -199,15 +204,17 @@ def _parse_fsweep(spec: str) -> list[int]:
 def _run_fsweep(cfg, args, platform_tag: str) -> int:
     """Run the padded single-program PBFT f-sweep and report one JSON line."""
     from .core import serialize
-    from .engines.pbft_sweep import fsweep_payload, pbft_fsweep_timed
+    from .engines.pbft_sweep import pbft_fsweep_timed, rung_payloads
 
     from .obs import trace as obs_trace
 
     fs = args.parsed_fs
     with obs_trace.span("pbft_fsweep", n_elements=len(fs),
-                        n_rounds=cfg.n_rounds):
+                        n_rounds=cfg.n_rounds,
+                        fault_model=cfg.fault_model):
         out, compile_s, wall, steps = pbft_fsweep_timed(cfg, fs)
-    payload = fsweep_payload(out)
+    per_rung = rung_payloads(out)
+    payload = b"".join(per_rung)
     if args.out:
         with open(args.out, "wb") as fp:
             fp.write(payload)
@@ -215,11 +222,17 @@ def _run_fsweep(cfg, args, platform_tag: str) -> int:
     print(json.dumps({
         "protocol": "pbft", "engine": "tpu", "platform": platform_tag,
         "f_sweep": args.f_sweep, "n_elements": len(fs),
-        "n_rounds": cfg.n_rounds, "seed": cfg.seed,
+        "n_rounds": cfg.n_rounds, "n_sweeps": cfg.n_sweeps,
+        "fault_model": cfg.fault_model, "seed": cfg.seed,
         "steps": steps, "wall_s": round(wall, 6),
         "steps_per_sec": round(steps / wall, 1) if wall > 0 else 0.0,
         "compile_s_one_program": round(compile_s, 3),
         "payload_bytes": len(payload),
+        # Per-rung digests == the digests of standalone f=fs[k],
+        # seed=seed+k, n_sweeps=K runs (engines/pbft_sweep.rung_payloads
+        # — the carve-out-lifting equivalence, pinned by both front
+        # doors in tests/test_cli.py).
+        "rung_digests": [serialize.digest(p) for p in per_rung],
         "digest": serialize.digest(payload),
     }))
     return 0
@@ -330,17 +343,20 @@ def main(argv=None) -> int:
             ("--checkpoint", args.checkpoint),
             ("--profile", args.profile),
             ("--retries/--deadline/--fallback-cpu", supervise),
-            ("--sweeps", cfg.n_sweeps != 1),
-            ("--fault-model bcast", cfg.fault_model == "bcast"),
             ("--crash-prob", cfg.crash_prob > 0),
             ("--telemetry", args.telemetry),
         ] if on]
         if unsupported:
             parser.error(f"{', '.join(unsupported)}: not supported with "
-                         "--f-sweep (the sweep axis is the f ladder itself; "
-                         "no checkpoint/profile hooks on this path yet)")
+                         "--f-sweep (no checkpoint/profile hooks on this "
+                         "path yet; §6c is unmodeled by the padded rounds)")
         try:
             args.parsed_fs = _parse_fsweep(args.f_sweep)
+            if cfg.n_byzantine > min(args.parsed_fs):
+                parser.error(
+                    f"--n-byzantine {cfg.n_byzantine} exceeds the smallest "
+                    f"--f-sweep rung f={min(args.parsed_fs)}; every rung "
+                    f"must satisfy the pbft n_byzantine <= f invariant")
         except ValueError as exc:
             parser.error(str(exc))
 

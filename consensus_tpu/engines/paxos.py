@@ -44,10 +44,12 @@ class PaxosState(NamedTuple):
 # increase across rounds, so no later prepare can be outbid by a
 # forgotten promise (SPEC §6c); acc_bal/acc_val (the accepted-value
 # history Paxos safety rests on) and the learner state persist.
-# Compiled-program contract (tools/hlocheck): sort-free (quorum counts
-# are plain reductions over the [N, S] grid); cumsum covers the slot
-# brackets. No node-sharded claim (digest-tested only, like dense raft).
-PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=6, node_sharded=None)
+# Compiled-program contract (tools/hlocheck): sort-free AND scan-free
+# (quorum counts and slot brackets are plain reductions over the [N, S]
+# grid — reduction cascades file under the reduce class, tools/hlocheck/
+# hlo.py `_scan_window`). No node-sharded claim (digest-tested only,
+# like dense raft).
+PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=0, node_sharded=None)
 
 CRASH_SPLIT = {
     "seed": "meta",

@@ -45,11 +45,13 @@ class PbftState(NamedTuple):
 # §6b bcast engine (same PbftState, same split — engines/pbft_bcast.py
 # declares it independently so the lint checks each round's code).
 # Compiled-program contract (tools/hlocheck): the dense §6 kernel
-# tallies pairwise — sort-free by design (budget 0 keeps it that way);
-# cumsum passes are the slot brackets. No node-sharded claim: the dense
-# [i, j, s] tensors are the engine the §6b bcast kernel exists to
-# replace at scale.
-PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=11, node_sharded=None)
+# tallies pairwise — sort-free AND scan-free by design (its tallies and
+# `_vth_select` searches are plain reductions; the former cumsum count
+# of 11 was reduction cascades the classifier now files under the
+# reduce class — tools/hlocheck/hlo.py `_scan_window`). No node-sharded
+# claim: the dense [i, j, s] tensors are the engine the §6b bcast
+# kernel exists to replace at scale.
+PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=0, node_sharded=None)
 
 CRASH_SPLIT = {
     "seed": "meta",

@@ -4,40 +4,50 @@ the large-N engine.
 The §6 dense kernel (engines/pbft.py) compares values pairwise:
 `[i, j, s]` tensors, O(N²·S) — structurally impossible at the north
 star's 100k-node scale (BASELINE.json:5 names PBFT in the 100k sweeps).
-Under §6b, faults drop a sender's round broadcast atomically, so a
-receiver's prepare/commit tally is a pure multiset count over the slot's
-sender values, computable in O(N·S·log N):
+Under §6b, faults drop a sender's round broadcast atomically, so every
+per-receiver multiset depends only on the receiver's partition side and
+the round collapses to per-(slot, side) aggregates — the same math the
+C++ oracle's ``round_bcast_fast`` proved byte-identical at benchmark
+scale (cpp/oracle.cpp, docs/PERF.md "oracle asymptotics"), now ported
+on-chip as the ROADMAP sort-diet:
 
-  * one `lax.sort` per slot over the sender values, carrying an index
-    payload (the permutation) plus every per-node flag the tallies
-    need, bit-packed into one i32 payload (partitions are
-    side-separable, §2 — the side flags ride along too);
-  * equal-value run boundaries in sorted order by elementwise compare;
-    each value's count of valid same-value senders gather-free from the
-    plain monotone cumsum of the validity flags, bracketed at the run
-    boundaries by a forward cummax / reverse cummin (builtin cumulative
-    ops — see _SortedTally.count). The sorted VALUES are never masked
-    to sentinels, so arbitrary 32-bit payloads are safe;
-  * both phases' tallies chain elementwise in sorted order and ONE
-    unsort (a second payload sort) returns the results (arbitrary-index
-    gathers run on the serial gather unit, ~15 ms per [16, 100k] pass
-    on v5 lite, so the design uses none; see _SortedTally).
+  * **P1** needs only the K-th/(K-1)-th largest sender view per side
+    (K = f+1): an order statistic, found by fixed-depth binary search on
+    the value range (views are bounded by 2·n_rounds; the `_vth_select`
+    move from the dense engine, docs/PERF.md round 5) — the former
+    batched `jnp.sort` is gone. The receiver-side insertion is a clamp:
+    adding own view x to a multiset whose K-th/(K-1)-th largest are
+    a1/a2 puts the new K-th largest at clip(x, a1, a2); a receiver that
+    IS a sender replaces its own copy, so its statistic is a1 directly.
+  * **P4/P5** tallies ride ONE `lax.sort` per round (down from three
+    sort passes): the slot's pp_val column is sorted once with the
+    per-node flags bit-packed into a single i32 payload, equal-value
+    runs are bracketed gather-free off the monotone cumsum
+    (`_SortedRuns.run_counts`), and — new — the results LEAVE sorted
+    space without the former unsort (a second payload sort; a
+    `.at[perm].set` scatter measured far worse, docs/PERF.md round 5):
+    at most ``_table_width(cfg)`` distinct values can reach any node's
+    quorum threshold (every passing value needs ≥ Q-1-n_byzantine ≥ f
+    valid same-value senders out of ≤ N, so ≤ N//(2f-byz) ≤ 4 values
+    qualify — exact, from the Config invariants n_nodes = 3f+1 and
+    n_byzantine <= f), so the top-M runs per (slot, side) — extracted
+    by M masked max-reductions — form a tiny (value, count) table that
+    answers every node's count by an elementwise value match in
+    ORIGINAL order. No gather, no scatter, no second sort.
+  * **P6** stays the per-side min-reduce + O(S) candidate-row select.
 
 Protocol phases, state, and tie-breaks are §6's verbatim; only fault
 granularity changes (SPEC §6b: per-sender drops, unchanged partitions,
-per-round equivocation stances). With drop_rate = partition_rate = 0 and
-no byzantine nodes this engine is round-for-round identical to the dense
-one (tested in tests/test_pbft_bcast.py, along with differential
-byte-equivalence vs the oracle's §6b path — cpp/oracle.cpp PbftSim with
-fault_bcast = 1, the BcastNet/del/eq_sup dispatch in PbftSim::run).
+per-round equivocation stances). Bit-identity is pinned three ways:
+against the retired sorted-tally round (kept as a test-only reference,
+tests/reference_pbft_bcast.py) across adversary grids, against the
+dense engine when faultless, and byte-for-byte against the oracle's
+independent per-receiver derivation (tests/test_pbft_bcast.py).
 """
 from __future__ import annotations
 
-from typing import NamedTuple
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core import rng
 from ..core.config import Config
@@ -48,22 +58,25 @@ from ..ops.adversary import bitcast_i32 as _i32
 from .pbft import PBFT_TELEMETRY, PbftState, pbft_init
 
 I32_MAX = jnp.iinfo(jnp.int32).max
+I32_MIN = jnp.iinfo(jnp.int32).min
 
 # SPEC §6c persistent/volatile carry split — identical to the dense §6
 # kernel's (engines/pbft.py: the fault granularity changes, the state
 # split does not); declared per-module so tools/lint (check `registry`)
 # verifies THIS round's reset/freeze code.
-# Compiled-program contract (tools/hlocheck): THE sort-class-bound round
-# (docs/PERF.md — carry-bandwidth floor 0.6% of HBM peak, the bytes are
-# sort temporaries). 3 sort passes/round compiled today (the two
-# _SortedTally payload sorts + the §2 partition-side order statistic);
-# the ROADMAP bandwidth-floor item exists to LOWER this number — the
-# budget is the ceiling that guarantees it can only go down. No
-# node-sharded claim yet: GSPMD currently gathers full [N, S]-class
+# Compiled-program contract (tools/hlocheck): the sort diet LANDED —
+# ONE compiled sort pass per round (the P4/P5 payload sort; P1 is a
+# binary-search order statistic, delivery is a top-M run table instead
+# of the former unsort) and the cumsum brackets down from 33 to the
+# run-count cumsum+cummax pairs. The budgets are LOWERED in the same
+# commit as the diet so it cannot creep back (docs/PERF.md "per-engine
+# sort budgets"); the retired 3-sort round is the negative fixture
+# proving the tightened ceiling fires (tests/test_hlocheck.py).
+# No node-sharded claim yet: GSPMD currently gathers full [N, S]-class
 # operands when the node axis is sharded (measured, hlocheck registry
 # notes) — flipping this to "bounded" is the acceptance bar for the
 # mesh-scaling refactor.
-PROGRAM_CONTRACT = dict(sort_budget=3, cumsum_budget=33, node_sharded=None)
+PROGRAM_CONTRACT = dict(sort_budget=1, cumsum_budget=20, node_sharded=None)
 
 CRASH_SPLIT = {
     "seed": "meta",
@@ -79,72 +92,271 @@ CRASH_SPLIT = {
 }
 
 
-class _SortedTally:
-    """Exact multiset counter, entirely in sorted space: count[s, j] =
-    |{i : valid[s, i] ∧ vals[s, i] == vals[s, j]}| for arbitrary i32
-    values (validity rides the permutation; nothing is masked to a
-    sentinel).
+def view_bound(cfg: Config) -> int:
+    """Static upper bound on any node's view when P1 runs: views start
+    at 0 and grow at most +2 per round (P0 churn, P2 timeout; the P1
+    catch-up never exceeds the current max, §6c recovery resets to 0),
+    so at round r < n_rounds every view is <= 2·n_rounds - 1. The same
+    bound the dense engine's `_vth_select` search uses."""
+    return 2 * cfg.n_rounds + 2
 
-    The round is sort-bound at N=100k, so the design minimizes
-    sort-class passes AND arbitrary-index gathers: ONE payload sort up
-    front carries the per-node flags (a searchsorted — even with the
-    sort-based lowering — would be a full extra sort per side, and the
-    default binary-search lowering is a 17-step sequential gather loop,
-    ~345 ms/call on v5 lite at [16, 100k], whose batched form faults
-    the TPU worker); counts are gather-free segmented scans over
-    equal-value runs (see count()); and ONE unsort (a second payload
-    sort keyed on the permutation) returns all phases' results
-    together. Callers unpack their flags from the sorted payload,
-    combine counts elementwise there (P4 → P5 chain included), and
-    unsort once.
+
+def _kth_largest(w1, ks, vmax: int):
+    """Row-wise k-th largest of N-padded multisets. ``w1``: [C, N] i32,
+    entry+1 for multiset members and 0 for pads — entries are ints in
+    [0, vmax], so pads sort below every entry exactly like the -1 pads
+    of the full sort this replaces. Returns [C] i32 in [-1, vmax]: the
+    largest v with |{j : w1[c, j] >= v + 1}| >= ks[c] (-1 when fewer
+    than k entries, the padded-sort semantics). Fixed-depth binary
+    search on the value range — the dense engine's `_vth_select` move
+    (docs/PERF.md round 5); ``ks`` may be traced (per-lane f in the
+    padded f-sweep round), [C] or broadcastable."""
+    n_rows = w1.shape[0]
+    lo = jnp.zeros((n_rows,), jnp.int32)
+    hi = jnp.full((n_rows,), vmax + 2, jnp.int32)
+    for _ in range(int(vmax + 1).bit_length()):
+        mid = (lo + hi) // 2
+        cnt = jnp.sum((w1 >= mid[:, None]).astype(jnp.int32), axis=1)
+        ok = cnt >= ks
+        lo = jnp.where(ok, mid, lo)
+        hi = jnp.where(ok, hi, mid)
+    return lo - 1
+
+
+def _table_width(n_nodes: int, f: int, equiv_byz: int) -> int:
+    """Static width M of the per-(slot, side) top-run tables — the
+    exactness bound of the aggregate delivery. A node passes a quorum
+    check iff cnt(value) >= Q - self_adj - extra, with self_adj <= 1
+    and extra <= equiv_byz (the equivocating-support ceiling), so any
+    value that can pass ANY node's threshold has
+    cnt >= Tmin = Q - 1 - equiv_byz = 2f - equiv_byz. Counts over one
+    (slot, side) sum to at most the valid-sender population <= n_nodes,
+    so at most n_nodes // Tmin distinct values qualify — all of them in
+    the top-M runs by count (a value below Tmin can never outrank one
+    at/above it). Config guarantees n_byzantine <= f, so Tmin >= f >= 1
+    whenever f >= 1; the f = 0 edge is n_nodes = 3f+1 = 1, where M = 1
+    covers every run outright. Flagship (f = 33333, no byz): M = 1."""
+    tmin = 2 * f - equiv_byz
+    return max(1, min(n_nodes, n_nodes // max(1, tmin)))
+
+
+class _SortedRuns:
+    """Equal-value run machinery over ONE batched payload sort —
+    the whole sort budget of the round.
+
+    ``vals_sn`` [S, N] is sorted along nodes with ``bits_sn`` (a packed
+    i32 of every per-node flag the tallies need — an extra sort payload
+    is ~free while a [16, 100k] arbitrary-index gather costs ~15 ms on
+    v5 lite) and optionally ``extra_sn`` (per-node equivocation
+    support) riding as payloads. Unlike the retired `_SortedTally`
+    there is NO index/permutation payload: nothing is ever unsorted —
+    results return to original order via the top-M run tables
+    (:func:`_top_runs` + a per-node value match). The sorted VALUES are
+    never masked to sentinels, so arbitrary 32-bit payloads are safe.
     """
 
     def __init__(self, vals_sn, bits_sn, extra_sn=None):
-        """``bits_sn``: per-(slot, node) i32 bitmask of every flag the
-        tally phases need, riding the sort as ONE payload (a [16, 100k]
-        arbitrary-index gather costs ~15 ms on v5 lite — 9 of them were
-        90% of the round — while an extra sort payload is ~free).
-        ``extra_sn``: optional i32 payload (equivocating-byz support)."""
-        S, N = vals_sn.shape
-        iota = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (S, N))
-        ops = (vals_sn, iota, bits_sn) + \
+        n_slots = vals_sn.shape[0]
+        ops = (vals_sn, bits_sn) + \
             (() if extra_sn is None else (extra_sn,))
         srt = jax.lax.sort(ops, dimension=1, num_keys=1)
-        self.sv, self.perm, self.bits = srt[0], srt[1], srt[2]
-        self.extra = srt[3] if extra_sn is not None else None
+        self.sv, self.bits = srt[0], srt[1]
+        self.extra = srt[2] if extra_sn is not None else None
         brk = self.sv[:, 1:] != self.sv[:, :-1]
-        self.newrun = jnp.concatenate([jnp.ones((S, 1), bool), brk], axis=1)
-        self.endrun = jnp.concatenate([brk, jnp.ones((S, 1), bool)], axis=1)
+        self.newrun = jnp.concatenate(
+            [jnp.ones((n_slots, 1), bool), brk], axis=1)
+        self.endrun = jnp.concatenate(
+            [brk, jnp.ones((n_slots, 1), bool)], axis=1)
 
-    def bit(self, k):
+    def bit(self, k: int):
         """Unpack flag k of the packed payload, sorted order [S, N]."""
         return ((self.bits >> k) & 1).astype(bool)
 
-    def count(self, valid_sn_sorted):
-        """Per-position count of valid entries in its equal-value run —
-        gather-free AND custom-scan-free. The plain (unsegmented)
-        inclusive cumsum ``s`` is nondecreasing, so the exclusive value
-        at a position's run START is the max of boundary-masked
-        ``s - f`` at-or-left of it (forward cummax), and the inclusive
-        value at its run END is the min of boundary-masked ``s``
-        at-or-right of it (reverse cummin); the difference is the run's
-        valid count. Builtin cumsum/cummax/cummin keep the optimized
-        TPU lowering — a custom-combine ``lax.associative_scan`` lowers
-        to ~17 levels of slice/pad/interleave passes that were ~35% of
-        the 100k program."""
-        f = valid_sn_sorted.astype(jnp.int32)
-        s = jnp.cumsum(f, axis=1)
-        ex_start = jax.lax.cummax(jnp.where(self.newrun, s - f, -1), axis=1)
-        s_end = jax.lax.cummin(jnp.where(self.endrun, s, jnp.int32(2**30)),
-                               axis=1, reverse=True)
-        return s_end - ex_start
+    def run_counts(self, valid_sn_sorted):
+        """Per-run count of valid entries, materialized at each run's
+        END position (garbage elsewhere — consumers mask with
+        ``endrun``): the plain inclusive cumsum at the end minus the
+        exclusive value at the run start, the start value propagated
+        forward by a boundary-masked cummax (builtin cumulative ops
+        keep the optimized TPU lowering — a custom-combine
+        ``lax.associative_scan`` lowers to ~17 levels of
+        slice/pad/interleave passes that were ~35% of the 100k
+        program). Two cumulative ops per call — the round's whole
+        cumsum-class surface is two of these per partition side."""
+        flags = valid_sn_sorted.astype(jnp.int32)
+        s = jnp.cumsum(flags, axis=1)
+        ex_start = jax.lax.cummax(
+            jnp.where(self.newrun, s - flags, -1), axis=1)
+        return s - ex_start
 
-    def unsort(self, packed_sn):
-        """Sorted-order [S, N] i32 payload → original [N, S] order via
-        one payload sort keyed on the permutation."""
-        _, out = jax.lax.sort((self.perm, packed_sn), dimension=1,
-                              num_keys=1)
-        return out.T
+
+def _top_runs(runs: _SortedRuns, end_counts, m: int):
+    """The ``m`` largest (value, count) runs per slot row, by count —
+    the segment-max extraction that replaces the unsort. ``end_counts``
+    is :meth:`_SortedRuns.run_counts` output (valid at run ends).
+    Returns ``(tv, tc)``: [S, m] i32 tables; ``tc == -1`` marks an
+    absent entry (fewer than m runs). Count ties break to the largest
+    value; each value appears at most once (the winning run's value is
+    masked out before the next extraction), and the choice cannot leak
+    into results — every value that can pass a threshold is in the
+    table (see :func:`_table_width`), the rest compare unequal."""
+    active = runs.endrun
+    tvs, tcs = [], []
+    for _ in range(m):
+        cur = jnp.where(active, end_counts, -1)
+        tc = jnp.max(cur, axis=1)                               # [S]
+        hit = (cur == tc[:, None]) & (tc[:, None] >= 0)
+        tv = jnp.max(jnp.where(hit, runs.sv, I32_MIN), axis=1)  # [S]
+        active = active & ~((runs.sv == tv[:, None])
+                            & (tc[:, None] >= 0))
+        tvs.append(tv)
+        tcs.append(tc)
+    return jnp.stack(tvs, axis=-1), jnp.stack(tcs, axis=-1)     # [S, m]
+
+
+def _table_count(vals, tv, tc):
+    """Count lookup against one (slot, side) table: for each entry of
+    ``vals`` ([..., S] with the slot axis LAST broadcastable against
+    the [S, m] tables), the count of its equal-value run — 0 when the
+    value is absent (then its true count is below every threshold, the
+    table-width argument). Pure elementwise match + sum over m; the
+    ``tc >= 0`` guard voids absent entries whatever garbage value they
+    hold."""
+    match = (vals[..., None] == tv) & (tc >= 0)
+    return jnp.sum(jnp.where(match, tc, 0), axis=-1)
+
+
+def _aggregate_tallies(pp_val, pp_seen, prepared, committed, honest, bcast,
+                       Q, m: int, *, side=None, part_active=None,
+                       eq_send=None, up=None):
+    """The shared §6b P4+P5 aggregate machinery — ONE payload sort,
+    per-(slot, side) top-``m`` run tables, elementwise delivery, with
+    the P4 → P5 chain running through the same tables in sorted space
+    so the two views cannot disagree. Used by BOTH the dedicated round
+    and the padded traced-f ladder round (engines/pbft_sweep.py), so a
+    fix to the quorum-count path can never diverge them.
+
+    ``Q`` may be traced (the ladder's per-lane 2f+1); ``m`` is the
+    static table width (:func:`_table_width`, maxed over rungs in the
+    ladder). ``side``/``part_active`` are None on the static
+    no-partition path; ``eq_send`` (byz & bcast & stance) is None
+    without equivocators; ``up`` is the §6c receiver mask (None when
+    crashes are off — down SENDERS are already outside every count via
+    the bcast fold).
+
+    Returns ``(prep_hit, prepared2, commit_now, c5)`` in original node
+    order — callers derive telemetry (prep_new/miss, commit_miss) and
+    state updates from these.
+    """
+    N, S = pp_val.shape
+    no_part = side is None
+
+    def side_ok(b):
+        return ~part_active | (side == b)
+
+    if eq_send is not None:
+        # Byz support is value-independent (SPEC §6b): one count per
+        # side, minus the receiver's own stance (self never travels).
+        if no_part:
+            extra = jnp.broadcast_to(jnp.sum(eq_send.astype(jnp.int32)),
+                                     (N,))
+        else:
+            extra = jnp.stack(
+                [jnp.sum((eq_send & side_ok(0)).astype(jnp.int32)),
+                 jnp.sum((eq_send & side_ok(1)).astype(jnp.int32))
+                 ])[side]                                        # [N]
+        extra = extra - (eq_send).astype(jnp.int32)
+        extra_sn = jnp.broadcast_to(extra[:, None], (N, S)).T
+    else:
+        extra = None
+        extra_sn = None
+
+    def b32(x):
+        return x.astype(jnp.int32)
+
+    bits = (b32(pp_seen) | (b32(prepared) << 1)
+            | ((b32(honest) | (b32(bcast) << 1))[:, None] << 2))
+    if not no_part:
+        bits |= ((b32(side) | (b32(side_ok(0)) << 1)
+                  | (b32(side_ok(1)) << 2))[:, None] << 4)
+    tal = _SortedRuns(pp_val.T, bits.T, extra_sn)
+    pp_seen_s, prepared_s = tal.bit(0), tal.bit(1)
+    honest_s, bcast_s = tal.bit(2), tal.bit(3)
+    hb_s = honest_s & bcast_s
+    extra_s = jnp.int32(0) if tal.extra is None else tal.extra
+
+    def tables_for(relevant_s):
+        """Per-side top-m (value, count) tables of the §6b multiset
+        count — valid honest broadcasting senders per value run."""
+        if no_part:
+            masks = (hb_s & relevant_s,)
+        else:
+            masks = (hb_s & tal.bit(5) & relevant_s,
+                     hb_s & tal.bit(6) & relevant_s)
+        pairs = [_top_runs(tal, tal.run_counts(mk), m) for mk in masks]
+        return ([tv for tv, _ in pairs], [tc for _, tc in pairs])
+
+    def counts_sorted(tvs, tcs):
+        """Table lookup for every SORTED position (the P4 → P5 chain):
+        position p's count is its value sv[p]'s table count on its own
+        side — exact for every count that can meet a threshold."""
+        if no_part:
+            return _table_count(tal.sv, tvs[0][:, None, :],
+                                tcs[0][:, None, :])
+        return jnp.where(tal.bit(4),
+                         _table_count(tal.sv, tvs[1][:, None, :],
+                                      tcs[1][:, None, :]),
+                         _table_count(tal.sv, tvs[0][:, None, :],
+                                      tcs[0][:, None, :]))
+
+    def counts_nodes(tvs, tcs):
+        """Table lookup in ORIGINAL node order — the delivery that
+        replaces the unsort. The ≤2 per-side tables are O(S·m) data;
+        selecting a node's side row is the same tiny-[2, ...]-by-side
+        select P6 already uses, never an [N, S] arbitrary gather."""
+        if no_part:
+            return _table_count(pp_val, tvs[0][None, :, :],
+                                tcs[0][None, :, :])
+        tv = jnp.stack(tvs)[side]                        # [N, S, m]
+        tc = jnp.stack(tcs)[side]
+        return _table_count(pp_val, tv, tc)
+
+    extra_n = jnp.int32(0) if extra is None else extra[:, None]
+
+    # ---- P4 prepare tally (value-matched §6b count incl. self: the
+    # self vote never travels, so it counts regardless of bcast fate).
+    tv4, tc4 = tables_for(pp_seen_s)
+    c4 = (counts_nodes(tv4, tc4)
+          + (honest[:, None] & pp_seen & ~bcast[:, None]).astype(jnp.int32)
+          + extra_n)
+    prep_hit = pp_seen & (c4 >= Q)
+    if up is not None:
+        # A down receiver can neither prepare nor commit (SPEC §6c) —
+        # masked here, not just frozen, so telemetry counters derived
+        # from these never report a quorum the trajectory didn't take.
+        prep_hit &= up[:, None]
+    prepared2 = prepared | prep_hit
+
+    # The sorted-space side of the same P4 decision, for P5's sender
+    # mask: each SENDER's own prepare verdict from the same tables +
+    # its own self/extra adjustments (the flags ride the sort payload).
+    # Down senders need no mask — they never broadcast, so hb_s already
+    # excludes them from every count.
+    c4_s = (counts_sorted(tv4, tc4)
+            + (honest_s & pp_seen_s & ~bcast_s).astype(jnp.int32)
+            + extra_s)
+    prepared2_s = prepared_s | (pp_seen_s & (c4_s >= Q))
+
+    # ---- P5 commit tally, chained off the P4 result.
+    tv5, tc5 = tables_for(prepared2_s)
+    c5 = (counts_nodes(tv5, tc5)
+          + (honest[:, None] & prepared2
+             & ~bcast[:, None]).astype(jnp.int32)
+          + extra_n)
+    commit_now = prepared2 & (c5 >= Q) & ~committed
+    if up is not None:
+        commit_now &= up[:, None]
+    return prep_hit, prepared2, commit_now, c5
 
 
 def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
@@ -161,19 +373,20 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     # ---- SPEC §6b adversary: per-sender broadcast drops + §2 partition.
     # partition_cutoff == 0 is a static config fact: the partition can
     # never activate, every side_ok() is identically true, and the two
-    # sides' tallies/sorts/minima are equal — so the no_part branches
-    # below compute one of everything instead of two (the 4 per-round
-    # multiset counts are ~60% of the round at N=100k). Bit-identical:
-    # streams are counter-based, so not drawing `side` changes nothing
-    # else. The general path is untouched.
+    # sides' aggregates are equal — so the no_part branches below
+    # compute one of everything instead of two. Bit-identical: streams
+    # are counter-based, so not drawing `side` changes nothing else.
+    # The general path is untouched.
     no_part = cfg.partition_cutoff == 0
     bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
     # SPEC §6c crash-recover adversary: a down node's round broadcasts
     # drop atomically (folded into the per-sender bcast flag — exactly
     # the §6b fault granularity); the receiving side is handled by
-    # masking the quorum/adopt events with `up` (the down flag rides
-    # the P4/P5 sort payload), so a frozen node also never *counts* a
-    # quorum it cannot apply — and then the state freeze below.
+    # masking the quorum/adopt events with `up` in ORIGINAL order, so a
+    # frozen node also never *counts* a quorum it cannot apply — and
+    # then the state freeze below. (The sorted-space chain needs no up
+    # flag: down nodes never broadcast, so they are already outside
+    # every honest-broadcasting count mask.)
     crash_on = cfg.crash_cutoff > 0
     down = st.down
     if crash_on:
@@ -218,28 +431,42 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     reset = jnp.broadcast_to(churn, (N,))
 
     # ---- P1 view catch-up: (f+1)-th largest of delivered honest views
-    # ∪ own. Senders are side-separable; per side b take the K-th and
-    # (K-1)-th largest sender views (ascending sort, -1 pads — views are
-    # always >= 0), then the receiver-side insertion is a clamp:
+    # ∪ own. Senders are side-separable; per side b the K-th and
+    # (K-1)-th largest sender views are ORDER STATISTICS of an
+    # N-padded multiset (pads below every view, like the retired sort's
+    # -1 pads) — a fixed-depth binary search on the bounded view range
+    # replaces the former batched [2, N] sort outright (sort-class ops
+    # 3 → 1 for the round). The receiver-side insertion is a clamp:
     # inserting own view x into a desc-sorted multiset T makes the K-th
     # largest clip(x, T[K-1], T[K-2]); a receiver that IS a sender
     # replaces its own copy, leaving the multiset unchanged.
     sender_v = honest & bcast
-    # One batched [2, N] sort for both partition sides: 1-D sorts hit a
-    # serial TPU path (~64 ms each at N=100k) while batched sorts are
-    # near-free; row-wise results are identical.
+    vmax = view_bound(cfg)
+    vplus = view + 1                                   # [1, vmax+1]; 0 = pad
     if no_part:
-        t = jnp.sort(jnp.where(sender_v, view, -1)[None, :], axis=1)
-        a1 = jnp.broadcast_to(t[0, N - K], (N,))                 # [N]
-        a2 = (jnp.broadcast_to(t[0, N - K + 1], (N,)) if K >= 2
-              else jnp.full((N,), I32_MAX, jnp.int32))
+        w1 = jnp.where(sender_v, vplus, 0)[None, :]              # [1, N]
+        if K >= 2:
+            stat = _kth_largest(jnp.concatenate([w1, w1]),
+                                jnp.asarray([K, K - 1], jnp.int32), vmax)
+            a1 = jnp.broadcast_to(stat[0], (N,))                 # [N]
+            a2 = jnp.broadcast_to(stat[1], (N,))
+        else:
+            stat = _kth_largest(w1, jnp.asarray([K], jnp.int32), vmax)
+            a1 = jnp.broadcast_to(stat[0], (N,))
+            a2 = jnp.full((N,), I32_MAX, jnp.int32)
     else:
-        cols = jnp.stack([jnp.where(sender_v & side_ok(0), view, -1),
-                          jnp.where(sender_v & side_ok(1), view, -1)])
-        t = jnp.sort(cols, axis=1)                               # ascending
-        a1 = t[:, N - K][side]                                   # [N]
-        a2 = (t[:, N - K + 1] if K >= 2
-              else jnp.full((2,), I32_MAX, jnp.int32))[side]
+        cols = jnp.stack([jnp.where(sender_v & side_ok(0), vplus, 0),
+                          jnp.where(sender_v & side_ok(1), vplus, 0)])
+        if K >= 2:
+            stat = _kth_largest(jnp.concatenate([cols, cols]),
+                                jnp.asarray([K, K, K - 1, K - 1],
+                                            jnp.int32), vmax)
+            a1 = stat[0:2][side]                                 # [N]
+            a2 = stat[2:4][side]
+        else:
+            a1 = _kth_largest(cols, jnp.asarray([K, K], jnp.int32),
+                              vmax)[side]
+            a2 = jnp.full((N,), I32_MAX, jnp.int32)
     in_set = sender_v                                            # self side ok
     vth = jnp.where(in_set, a1, jnp.clip(view, a1, a2))
     catch = vth > view
@@ -289,80 +516,20 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     pp_val = jnp.where(accept, pm_val, pp_val)
     pp_seen = pp_seen | accept
 
-    # ---- P4 + P5 tallies, entirely in sorted space (one sort carrying
-    # every needed flag as a packed payload, one unsort — see
-    # _SortedTally). The P4 → P5 dependency (commit votes only count
-    # prepared nodes) chains elementwise in sorted order.
-    if equiv:
-        # Byz support is value-independent (SPEC §6b): one count per
-        # side, minus the receiver's own stance (self never travels).
-        eq_send = byz & bcast & stance
-        if no_part:
-            extra = jnp.broadcast_to(jnp.sum(eq_send.astype(jnp.int32)),
-                                     (N,))
-        else:
-            extra = jnp.stack(
-                [jnp.sum((eq_send & side_ok(0)).astype(jnp.int32)),
-                 jnp.sum((eq_send & side_ok(1)).astype(jnp.int32))
-                 ])[side]                                        # [N]
-        extra = extra - (eq_send).astype(jnp.int32)
-        extra_sn = jnp.broadcast_to(extra[:, None], (N, S)).T
-    else:
-        extra_sn = None
-
-    def b32(x):
-        return x.astype(jnp.int32)
-
-    bits = (b32(pp_seen) | (b32(prepared) << 1) | (b32(committed) << 2)
-            | ((b32(honest) | (b32(bcast) << 1))[:, None] << 3))
-    if not no_part:
-        bits |= ((b32(side) | (b32(side_ok(0)) << 1)
-                  | (b32(side_ok(1)) << 2))[:, None] << 5)
-    if crash_on:
-        bits |= b32(up)[:, None] << 8
-    tal = _SortedTally(pp_val.T, bits.T, extra_sn)
-    pp_seen_s, prepared_s, committed_s = tal.bit(0), tal.bit(1), tal.bit(2)
-    honest_s, bcast_s = tal.bit(3), tal.bit(4)
-    hb_s = honest_s & bcast_s
-    extra_s = jnp.int32(0) if tal.extra is None else tal.extra
-
-    def counts_for_s(relevant_s):
-        """Value-matched §6b count incl. self (SPEC §6 P4/P5), sorted
-        order: sorted-count of broadcasting senders + the self vote
-        (which never travels, so it counts regardless of bcast fate)."""
-        if no_part:
-            cnt = tal.count(hb_s & relevant_s)
-        else:
-            c0 = tal.count(hb_s & tal.bit(6) & relevant_s)
-            c1 = tal.count(hb_s & tal.bit(7) & relevant_s)
-            cnt = jnp.where(tal.bit(5), c1, c0)
-        self_adj = (honest_s & relevant_s & ~bcast_s).astype(jnp.int32)
-        return cnt + self_adj + extra_s
-
-    # ---- P4 prepare tally. (Telemetry masks are computed in SORTED
-    # order — their jnp.sum totals are permutation-invariant, so no
-    # extra unsort payload is ever needed for them.)
-    c4 = counts_for_s(pp_seen_s)
-    prep_hit_s = pp_seen_s & (c4 >= Q)
-    if crash_on:
-        # A down receiver can neither prepare nor commit (SPEC §6c) —
-        # masked here, not just frozen, so the telemetry counters below
-        # never report a quorum the trajectory didn't take.
-        prep_hit_s &= tal.bit(8)
-    prep_new_s = prep_hit_s & ~prepared_s       # telemetry (DCE'd when off)
-    prep_miss_s = pp_seen_s & ~prepared_s & ~prep_hit_s
-    prepared2_s = prepared_s | prep_hit_s
-
-    # ---- P5 commit tally.
-    c5 = counts_for_s(prepared2_s)
-    commit_now_s = prepared2_s & (c5 >= Q) & ~committed_s
-    if crash_on:
-        commit_now_s &= tal.bit(8)
-    commit_miss_s = prepared2_s & ~committed_s & (c5 < Q)  # telemetry
-
-    packed = tal.unsort(b32(prepared2_s) | (b32(commit_now_s) << 1))
-    prepared = (packed & 1).astype(bool)
-    commit_now = (packed >> 1).astype(bool)
+    # ---- P4 + P5 tallies: one payload sort, per-(slot, side) top-M
+    # run tables, elementwise delivery (:func:`_aggregate_tallies` —
+    # shared with the padded traced-f ladder round).
+    prep_hit, prepared2, commit_now, c5 = _aggregate_tallies(
+        pp_val, pp_seen, prepared, committed, honest, bcast, Q,
+        _table_width(N, f, cfg.n_byzantine if equiv else 0),
+        side=None if no_part else side,
+        part_active=None if no_part else part_active,
+        eq_send=(byz & bcast & stance) if equiv else None,
+        up=up if crash_on else None)
+    prep_new = prep_hit & ~prepared        # telemetry (DCE'd when off)
+    prep_miss = pp_seen & ~prepared & ~prep_hit
+    prepared = prepared2
+    commit_miss = prepared & ~committed & (c5 < Q)  # telemetry
     dval = jnp.where(commit_now, pp_val, dval)
     committed = committed | commit_now
 
@@ -412,12 +579,12 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
                     prepared, committed, dval, down)
     if not telem:
         return new
-    cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    cnt = lambda mk: jnp.sum(mk.astype(jnp.int32))  # noqa: E731
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
     # view_changes clips at 0 like the dense kernel: a §6c recovery
     # resets the view, and the raw delta would cancel real advances.
-    vec = jnp.stack([cnt(prep_new_s), cnt(prep_miss_s), cnt(commit_now_s),
-                     cnt(commit_miss_s), cnt(adopt),
+    vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
+                     cnt(commit_miss), cnt(adopt),
                      jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
     return new, vec
 

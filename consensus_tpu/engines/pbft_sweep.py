@@ -1,10 +1,10 @@
-"""Batched PBFT f-sweep: f = 1..128 as a batch axis of ONE XLA program.
+"""Batched PBFT f-sweep: a whole f ladder as ONE XLA program.
 
 The reference runs its `pbft::quorum` f-sweep [B:9] as one process per f
 (each with N = 3f+1 nodes). A naive TPU port would compile 128 separate
 programs (shapes differ per f) — ~an hour of XLA compiles for seconds of
 execution. Instead, the TPU-native design pads every sweep element to
-N_pad = 3·f_max+1 nodes and makes (n_real, f) *traced per-sweep scalars*:
+N_pad = 3·f_max+1 nodes and makes (n_real, f) *traced per-lane scalars*:
 
   * padded nodes are never honest senders, never delivered to/from, and
     are sliced off before serialization — and because every RNG draw is
@@ -14,6 +14,23 @@ N_pad = 3·f_max+1 nodes and makes (n_real, f) *traced per-sweep scalars*:
     in tests/test_pbft_sweep.py.
   * quorum threshold Q = 2f+1 and primary = view mod n_real use the
     traced scalars, so one compiled kernel serves every f.
+
+BOTH fault models compile this way (the former `--f-sweep` carve-outs,
+VERDICT weak #5, are lifted): ``fault_model="edge"`` runs the dense
+SPEC §6 round (:func:`pbft_round_padded`) and ``fault_model="bcast"``
+runs the §6b aggregate sort-diet round
+(:func:`pbft_bcast_round_padded` — the engines/pbft_bcast.py kernel
+with traced (n_real, f): one payload sort, binary-search order
+statistics, top-M run-table delivery). A bcast f ladder that used to
+need one process per rung is now one compiled program, contract-pinned
+at trace time by the ``pbft-100k-bcast-fsweep`` hlocheck target.
+
+The ladder also carries an independent-sweeps axis: ``cfg.n_sweeps``
+instances per rung run as extra vmap lanes — lane (rung k, sweep j)
+seeds at lo32(seed + k + j), exactly the seed vector an individual
+``f=fs[k], seed=seed+k, n_sweeps=K`` run would use, so per-rung decided
+payloads stay byte-equal to standalone runs (the CLI equivalence
+contract, tests/test_cli.py).
 
 Cost: ~3.4x the FLOPs of the exact per-f sum (padding waste), repaid
 >100x over in avoided compiles; the whole sweep runs as one `vmap` under
@@ -36,6 +53,8 @@ from .pbft import _adopt_val, _vth_select
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import bitcast_i32 as _i32
 from .pbft import PbftState
+from .pbft_bcast import (_aggregate_tallies, _kth_largest, _table_width,
+                         view_bound)
 
 
 def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
@@ -168,17 +187,185 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
                      prepared, committed, dval, st.down)
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _fsweep_jit(cfg: Config, seeds, n_reals, fs):
+def pbft_bcast_round_padded(cfg: Config, st: PbftState, r, n_real, f,
+                            m_cap: int):
+    """One SPEC §6b round on a padded population — the aggregate
+    sort-diet kernel of engines/pbft_bcast.py with traced per-lane
+    (n_real, f): ONE payload sort, binary-search P1 order statistics
+    (traced ranks K = f+1, f), and top-``m_cap`` run-table delivery.
+
+    ``m_cap`` is the static table width covering every lane:
+    max over rungs of ``_table_width(3f+1, f, byz)`` — a lane's live
+    senders are <= 3f+1 (padded nodes never send), so the per-lane
+    exactness bound holds inside the shared padded shape. Crash (§6c)
+    is rejected upstream; padded receivers accumulate garbage that the
+    extraction slices off, and never influence real nodes (they are
+    never senders, primaries, nor deciders).
+    """
+    N, S = cfg.n_nodes, cfg.log_capacity
+    Q = 2 * f + 1
+    K = f + 1
+    seed = st.seed
+    ur = jnp.asarray(r, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    uidx = idx.astype(jnp.uint32)
+    sarange = jnp.arange(S, dtype=jnp.int32)
+    real = idx < n_real
+
+    no_part = cfg.partition_cutoff == 0
+    bcast = (rng.delivery_u32_jnp(seed, ur, uidx, uidx)
+             >= _lt(cfg.drop_cutoff)) & real
+    if not no_part:
+        part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
+                       < _lt(cfg.partition_cutoff))
+        side = (_draw(seed, rng.STREAM_PARTITION, ur, 1, uidx)
+                & jnp.uint32(1)).astype(jnp.int32)               # [N]
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+    honest = idx < (n_real - cfg.n_byzantine)
+    byz = real & ~honest
+
+    def side_ok(b):
+        return ~part_active | (side == b)
+
+    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
+    if equiv:
+        stance = (_draw(seed, rng.STREAM_EQUIV, ur, uidx,
+                        jnp.uint32(0x80000000)) & jnp.uint32(1)).astype(bool)
+
+    view, timer = st.view, st.timer
+    pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
+    prepared, committed, dval = st.prepared, st.committed, st.dval
+    committed_at_start = committed
+
+    # ---- P0 churn.
+    view = view + churn.astype(jnp.int32)
+    timer = jnp.where(churn, 0, timer)
+    reset = jnp.broadcast_to(churn, (N,))
+
+    # ---- P1 per-side order statistics (ranks traced: K, K-1; the
+    # ladder validates f >= 1, so K-1 >= 1 always has a defined rank).
+    sender_v = honest & bcast
+    vmax = view_bound(cfg)
+    vplus = view + 1
+    if no_part:
+        w1 = jnp.where(sender_v, vplus, 0)[None, :]              # [1, N]
+        stat = _kth_largest(jnp.concatenate([w1, w1]),
+                            jnp.stack([K, K - 1]).astype(jnp.int32), vmax)
+        a1 = jnp.broadcast_to(stat[0], (N,))
+        a2 = jnp.broadcast_to(stat[1], (N,))
+    else:
+        cols = jnp.stack([jnp.where(sender_v & side_ok(0), vplus, 0),
+                          jnp.where(sender_v & side_ok(1), vplus, 0)])
+        stat = _kth_largest(jnp.concatenate([cols, cols]),
+                            jnp.stack([K, K, K - 1, K - 1])
+                            .astype(jnp.int32), vmax)
+        a1 = stat[0:2][side]
+        a2 = stat[2:4][side]
+    vth = jnp.where(sender_v, a1, jnp.clip(view, a1, a2))
+    catch = vth > view
+    view = jnp.where(catch, vth, view)
+    timer = jnp.where(catch, 0, timer)
+    reset |= catch
+
+    # ---- P2 timeout.
+    to = timer >= cfg.view_timeout
+    view = view + to.astype(jnp.int32)
+    timer = jnp.where(to, 0, timer)
+    reset |= to
+
+    # ---- P3 pre-prepare.
+    is_primary = honest & (view % n_real == idx)
+    fresh = jnp.min(jnp.where(~pp_seen, sarange[None, :], S), axis=1)
+    fresh_hot = (sarange[None, :] == fresh[:, None])
+    ppb = is_primary[:, None] & ((pp_seen & ~committed) | fresh_hot)
+    fresh_val = _i32(_draw(seed, rng.STREAM_VALUE,
+                           view[:, None].astype(jnp.uint32), 2,
+                           sarange[None, :].astype(jnp.uint32)))
+    msg_val = jnp.where(pp_seen, pp_val, fresh_val)
+
+    prim = view % n_real
+    if no_part:
+        prim_del = (prim == idx) | bcast[prim]
+    else:
+        prim_del = (prim == idx) | (bcast[prim]
+                                    & (~part_active | (side[prim] == side)))
+    prim_ok = prim_del & (view[prim] == view) & real
+    pm_b = ppb[prim]
+    pm_val = msg_val[prim]
+    if equiv:
+        prim_byz = byz[prim]
+        bval = _i32(_draw(seed, rng.STREAM_VALUE,
+                          view[:, None].astype(jnp.uint32),
+                          jnp.where(stance[prim], 4, 3)[:, None]
+                          .astype(jnp.uint32),
+                          sarange[None, :].astype(jnp.uint32)))
+        prim_ok = jnp.where(prim_byz, prim_del & real, prim_ok)
+        pm_b = pm_b | prim_byz[:, None]
+        pm_val = jnp.where(prim_byz[:, None], bval, pm_val)
+    accept = (prim_ok[:, None] & pm_b
+              & (~pp_seen | (pp_view < view[:, None]))
+              & (~prepared | (pm_val == pp_val)))
+    pp_view = jnp.where(accept, view[:, None], pp_view)
+    pp_val = jnp.where(accept, pm_val, pp_val)
+    pp_seen = pp_seen | accept
+
+    # ---- P4 + P5: the SHARED aggregate machinery (one payload sort +
+    # top-M run tables, pbft_bcast._aggregate_tallies) with traced Q
+    # and the rung-maxed static table width — one quorum-count path for
+    # the dedicated engine and the ladder, so they cannot drift.
+    _, prepared, commit_now, _ = _aggregate_tallies(
+        pp_val, pp_seen, prepared, committed, honest, bcast, Q, m_cap,
+        side=None if no_part else side,
+        part_active=None if no_part else part_active,
+        eq_send=(byz & bcast & stance) if equiv else None)
+    dval = jnp.where(commit_now, pp_val, dval)
+    committed = committed | commit_now
+
+    # ---- P6 decide gossip: lowest-id broadcasting decider per side.
+    dec = honest[:, None] & bcast[:, None] & committed
+    if no_part:
+        src = jnp.where(dec, idx[:, None], N)
+        imin_rows = jnp.min(src, axis=0)[None, :]
+        imin = jnp.broadcast_to(imin_rows, (N, S))
+    else:
+        rows = []
+        for b in (0, 1):
+            src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
+            rows.append(jnp.min(src, axis=0))
+        imin_rows = jnp.stack(rows)
+        imin = imin_rows[side]
+    adopt = (imin < N) & ~committed
+    val_rows = dval[jnp.clip(imin_rows, 0, N - 1), sarange[None, :]]
+    vfull = (jnp.broadcast_to(val_rows, (N, S)) if no_part
+             else val_rows[side])
+    dval = jnp.where(adopt, vfull, dval)
+    committed = committed | adopt
+
+    # ---- P7 timer.
+    new_commit = jnp.any(committed & ~committed_at_start, axis=1)
+    timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
+                      timer + 1)
+
+    return PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
+                     prepared, committed, dval, st.down)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _fsweep_jit(cfg: Config, m_cap: int, seeds, n_reals, fs):
     from .pbft import pbft_init
 
     st0 = jax.vmap(lambda s: pbft_init(cfg, s))(seeds)
     rounds = jnp.arange(cfg.n_rounds, dtype=jnp.int32)
+    bcast = cfg.fault_model == "bcast"
 
     def body(sts, r):
-        return jax.vmap(
-            lambda s, n, f: pbft_round_padded(cfg, s, r, n, f)
-        )(sts, n_reals, fs), None
+        if bcast:
+            fn = lambda s, n, f: pbft_bcast_round_padded(  # noqa: E731
+                cfg, s, r, n, f, m_cap)
+        else:
+            fn = lambda s, n, f: pbft_round_padded(  # noqa: E731
+                cfg, s, r, n, f)
+        return jax.vmap(fn)(sts, n_reals, fs), None
 
     stF, _ = jax.lax.scan(body, st0, rounds)
     return stF
@@ -192,7 +379,8 @@ def pbft_fsweep_timed(cfg: Config, fs, repeats: int = 1):
     Returns ``(out, compile_s, best_wall_s, real_steps)`` where the first
     call's wall time is the compile+warmup cost, ``best_wall_s`` is the
     best of ``repeats`` warm executions, and ``real_steps`` counts only
-    real 3f+1 nodes — padded lanes are FLOP waste, not simulated work.
+    real 3f+1 nodes (times ``cfg.n_sweeps`` instances per rung) — padded
+    lanes are FLOP waste, not simulated work.
 
     Each timed repeat dispatches a DIFFERENT element-seed vector (base
     seed offset by (r+1)*len(fs)): the tunnel backend caches identical
@@ -205,14 +393,6 @@ def pbft_fsweep_timed(cfg: Config, fs, repeats: int = 1):
     import time
 
     from ..network.runner import _sync_elem
-
-    if cfg.crash_cutoff > 0:
-        # The padded round kernel carries the down mask unchanged — a
-        # crashing config would silently simulate zero crashes (the
-        # same divergence Config rejects for the cpu engine).
-        raise ValueError("the pbft f-sweep does not implement the SPEC "
-                         "§6c crash-recover adversary; run per-f configs "
-                         "instead of --f-sweep with crash_prob > 0")
 
     def sync(st):
         # Timing policy matches time_tpu (benchmarks/run_benchmarks.py):
@@ -232,8 +412,25 @@ def pbft_fsweep_timed(cfg: Config, fs, repeats: int = 1):
         stF = _fsweep_device(cfg, fs, seed_offset=(rep + 1) * len(fs))
         sync(stF)
         best = min(best, time.perf_counter() - t0)
-    real_steps = sum(3 * int(f) + 1 for f in fs) * cfg.n_rounds
-    return _fsweep_slice(st0, fs), compile_s, best, real_steps
+    real_steps = (sum(3 * int(f) + 1 for f in fs) * cfg.n_rounds
+                  * cfg.n_sweeps)
+    return _fsweep_slice(st0, fs, cfg.n_sweeps), compile_s, best, real_steps
+
+
+def rung_payloads(out) -> list[bytes]:
+    """Per-rung canonical decided payloads: rung k's bytes are EXACTLY
+    what a standalone ``f=fs[k], seed=seed+k, n_sweeps=K`` run
+    serializes (network/simulator.decided_payload over the same
+    pack_sparse), so per-rung digests compare 1:1 with individual runs
+    — the lifted-carve-out acceptance contract (tests/test_cli.py)."""
+    from ..core import serialize
+
+    payloads = []
+    for o in out:
+        c, s, v = serialize.pack_sparse(o["committed"].astype(bool),
+                                        o["dval"])
+        payloads.append(serialize.serialize_decided("pbft", c, s, v))
+    return payloads
 
 
 def fsweep_payload(out) -> bytes:
@@ -241,47 +438,91 @@ def fsweep_payload(out) -> bytes:
     handle for a ladder run (byte-equal to running each f alone). One
     definition shared by the CLI's --f-sweep report and the benchmark
     suite so their digests cannot drift."""
-    from ..core import serialize
-
-    payload = b""
-    for o in out:
-        c, s, v = serialize.pack_sparse(
-            o["committed"][None].astype(bool), o["dval"][None])
-        payload += serialize.serialize_decided("pbft", c, s, v)
-    return payload
+    return b"".join(rung_payloads(out))
 
 
 def pbft_fsweep_run(cfg: Config, fs) -> list[dict]:
-    """Run sweep element k with f = fs[k], seed = cfg.seed + k, all in one
-    compiled program. ``cfg.f`` is ignored; ``cfg.n_nodes`` may be 0 (it
-    is replaced by the padded size). Returns one dict per element with
-    arrays sliced back to that element's real 3f+1 nodes — identical
-    layout to engines.pbft.pbft_run's per-sweep output.
+    """Run the f ladder, ``cfg.n_sweeps`` instances per rung, in one
+    compiled program: rung k sweep j uses f = fs[k], seed = lo32(seed +
+    k + j). ``cfg.f`` is ignored; ``cfg.n_nodes`` may be 0 (it is
+    replaced by the padded size). Returns one dict per rung with arrays
+    sliced back to that rung's real 3f+1 nodes, batched over the rung's
+    sweeps — identical layout to engines.pbft.pbft_run's output for the
+    equivalent standalone config.
     """
-    return _fsweep_slice(_fsweep_device(cfg, fs), fs)
+    return _fsweep_slice(_fsweep_device(cfg, fs), fs, cfg.n_sweeps)
+
+
+def _fsweep_static(cfg: Config, fs):
+    """Validate a ladder request and derive its static compile
+    parameters: the padded config (one vmap lane per (rung, sweep)) and
+    the bcast table width covering every rung. Shared by the dispatch
+    path (:func:`_fsweep_device`) and the hlocheck trace-time lowering
+    (:func:`fsweep_lower`), so the contract-pinned program IS the
+    dispatched one."""
+    import dataclasses
+
+    fs = [int(f) for f in fs]
+    if not fs or min(fs) < 1:
+        raise ValueError(f"f-sweep rungs must be >= 1, got {fs!r}")
+    if cfg.crash_cutoff > 0:
+        # The padded round kernels carry the down mask unchanged — a
+        # crashing config would silently simulate zero crashes (the
+        # same divergence Config rejects for the cpu engine).
+        raise ValueError("the pbft f-sweep does not implement the SPEC "
+                         "§6c crash-recover adversary; run per-f configs "
+                         "instead of --f-sweep with crash_prob > 0")
+    if cfg.n_byzantine > min(fs):
+        # Per-rung equivalence is against a standalone f=fs[k] run,
+        # whose Config requires n_byzantine <= f — a rung below the byz
+        # count has no valid standalone twin to be byte-equal to.
+        raise ValueError(f"n_byzantine={cfg.n_byzantine} exceeds the "
+                         f"smallest rung f={min(fs)}; every rung must "
+                         f"satisfy the pbft n_byzantine <= f invariant")
+    n_pad = 3 * max(fs) + 1
+    cfg_pad = dataclasses.replace(cfg, protocol="pbft", f=max(fs),
+                                  n_nodes=n_pad,
+                                  n_sweeps=len(fs) * cfg.n_sweeps)
+    eb = (cfg.n_byzantine
+          if cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0 else 0)
+    m_cap = max(_table_width(3 * f + 1, f, eb) for f in fs)
+    return fs, cfg_pad, m_cap
 
 
 def _fsweep_device(cfg: Config, fs, seed_offset: int = 0):
     """Run the one-program ladder; return the padded final state ON
     DEVICE (callers extract or sync as appropriate). ``seed_offset``
-    shifts every element's seed WITHOUT touching the (static, compiled)
+    shifts every lane's seed WITHOUT touching the (static, compiled)
     config — the cache-defeating repeat knob of pbft_fsweep_timed; a
     seed change via dataclasses.replace(cfg, ...) would recompile."""
-    import dataclasses
-
-    fs = [int(f) for f in fs]
-    n_pad = 3 * max(fs) + 1
-    cfg_pad = dataclasses.replace(cfg, protocol="pbft", f=max(fs),
-                                  n_nodes=n_pad, n_sweeps=len(fs))
-    seeds = ((np.uint64(cfg.seed) + np.uint64(seed_offset)
-              + np.arange(len(fs), dtype=np.uint64))
+    fs, cfg_pad, m_cap = _fsweep_static(cfg, fs)
+    ks = np.repeat(np.arange(len(fs), dtype=np.uint64), cfg.n_sweeps)
+    js = np.tile(np.arange(cfg.n_sweeps, dtype=np.uint64), len(fs))
+    seeds = ((np.uint64(cfg.seed) + np.uint64(seed_offset) + ks + js)
              & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    n_reals = jnp.asarray([3 * f + 1 for f in fs], jnp.int32)
-    return _fsweep_jit(cfg_pad, jnp.asarray(seeds), n_reals,
-                       jnp.asarray(fs, jnp.int32))
+    n_reals = jnp.asarray(np.repeat([3 * f + 1 for f in fs],
+                                    cfg.n_sweeps), jnp.int32)
+    fs_lanes = jnp.asarray(np.repeat(fs, cfg.n_sweeps), jnp.int32)
+    return _fsweep_jit(cfg_pad, m_cap, jnp.asarray(seeds), n_reals,
+                       fs_lanes)
 
 
-def _fsweep_slice(stF, fs) -> list[dict]:
+def fsweep_lower(cfg: Config, fs):
+    """Trace-time lowering of the exact one-program ladder
+    :func:`_fsweep_device` dispatches, over ShapeDtypeStructs — the
+    hlocheck `pbft-100k-bcast-fsweep` target (tools/hlocheck/hlo.py).
+    A ladder is ONE dispatch (no chunked cross-dispatch carry), so the
+    donation contract sees zero carry leaves by construction."""
+    fs, cfg_pad, m_cap = _fsweep_static(cfg, fs)
+    lanes = cfg_pad.n_sweeps
+    return _fsweep_jit.lower(
+        cfg_pad, m_cap,
+        jax.ShapeDtypeStruct((lanes,), jnp.uint32),
+        jax.ShapeDtypeStruct((lanes,), jnp.int32),
+        jax.ShapeDtypeStruct((lanes,), jnp.int32))
+
+
+def _fsweep_slice(stF, fs, n_sweeps: int) -> list[dict]:
     # Pull each padded array ONCE and slice on the host: per-rung device
     # slicing issued 3 tiny transfers per rung — ~2·|fs| tunnel
     # round-trips that dominated the measured wall at |fs|=128 (~26 s
@@ -292,9 +533,10 @@ def _fsweep_slice(stF, fs) -> list[dict]:
     out = []
     for k, f in enumerate(fs):
         n = 3 * int(f) + 1
+        lanes = slice(k * n_sweeps, (k + 1) * n_sweeps)
         out.append({
-            "committed": committed[k, :n],
-            "dval": dval[k, :n],
-            "view": view[k, :n],
+            "committed": committed[lanes, :n],
+            "dval": dval[lanes, :n],
+            "view": view[lanes, :n],
         })
     return out

@@ -57,11 +57,11 @@ class RaftState(NamedTuple):
 # "compiled-program layer"): regression CEILINGS on the lowered round
 # program — the sort-diet work may lower them, never raise them. The
 # dense [N, N] kernel is sort-free; its cumsum passes are the log-match
-# brackets at benchmark L (shape-dependent lowering: the 5-node config
-# compiles them away entirely). No node-sharded claim: the dense
+# brackets lower as plain-reduction cascades, filed under the reduce
+# class (tools/hlocheck/hlo.py `_scan_window`) — the round is scan-free. No node-sharded claim: the dense
 # engine's multi-chip story is digest-tested (test_runner), not
 # structure-claimed — the capped §3b engine owns that claim.
-PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=21, node_sharded=None)
+PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=0, node_sharded=None)
 
 CRASH_SPLIT = {
     "seed": "meta",

@@ -91,12 +91,14 @@ class RaftSparseState(NamedTuple):
 # (re-)election and never tracks a down node, so recovery resets and
 # the down-freeze both bypass them by construction.
 # Compiled-program contract (tools/hlocheck): 2 sorts/round (the §3b
-# tracked-set maintenance) is the ceiling; cumsum covers the capped
-# tally brackets. node_sharded="strict" is the repo's multi-chip claim
+# tracked-set maintenance) is the ceiling; the round is scan-free (the
+# former cumsum count of 30 was plain-reduction cascades, reclassified
+# by tools/hlocheck/hlo.py `_scan_window`). node_sharded="strict" is
+# the repo's multi-chip claim
 # (ROADMAP, tests/test_mesh_collectives.py): under node sharding the
 # round stays in the all-reduce family at the canonical shape and every
 # collective is O(N) metadata at flagship N — never the [N, L] carry.
-PROGRAM_CONTRACT = dict(sort_budget=2, cumsum_budget=30,
+PROGRAM_CONTRACT = dict(sort_budget=2, cumsum_budget=0,
                         node_sharded="strict")
 
 CRASH_SPLIT = {

@@ -1,0 +1,316 @@
+"""The RETIRED pre-diet §6b round, kept verbatim as a test-only
+reference (PR "sort-diet", ISSUE 8).
+
+This is the engines/pbft_bcast.py kernel as committed before the
+aggregate sort-diet: the §2 partition-side statistics come from a full
+batched `jnp.sort`, and the P4/P5 tallies run through `_SortedTally` —
+one payload sort carrying a permutation + flags, per-position counts
+off cumsum/cummax/cummin brackets, and ONE unsort (a second payload
+sort) returning results to node order. Three compiled sort passes per
+round; the production round now compiles to ONE (docs/PERF.md).
+
+Two jobs:
+
+  * bit-identity oracle — tests/test_pbft_bcast.py drives this round
+    and the production round through the SAME runner across the
+    adversary grid (drops, partitions, churn, byz silent/equivocate,
+    §6c crash) and asserts every extracted state leaf and telemetry
+    counter is identical;
+  * negative fixture — compiled through the production chunk jit it
+    EXCEEDS the lowered `PROGRAM_CONTRACT` ceilings (3 sorts > 1,
+    30 scan-class brackets > 20), proving the tightened sort-diet
+    ceiling fires on precisely the program it retired
+    (tests/test_hlocheck.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from consensus_tpu.core import rng
+from consensus_tpu.core.config import Config
+from consensus_tpu.engines.pbft import PBFT_TELEMETRY, PbftState, pbft_init
+from consensus_tpu.engines.pbft_bcast import _extract, _pspec
+from consensus_tpu.network.runner import EngineDef
+from consensus_tpu.ops.adversary import (crash_counts, crash_transition,
+                                         freeze_down)
+from consensus_tpu.ops.adversary import draw as _draw
+from consensus_tpu.ops.adversary import cutoff as _lt
+from consensus_tpu.ops.adversary import bitcast_i32 as _i32
+
+I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class _SortedTally:
+    """Exact multiset counter, entirely in sorted space (retired): one
+    payload sort up front carrying the permutation + flags, counts from
+    the monotone cumsum bracketed at run boundaries, ONE unsort (a
+    second payload sort keyed on the permutation) returning results."""
+
+    def __init__(self, vals_sn, bits_sn, extra_sn=None):
+        S, N = vals_sn.shape
+        iota = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32), (S, N))
+        ops = (vals_sn, iota, bits_sn) + \
+            (() if extra_sn is None else (extra_sn,))
+        srt = jax.lax.sort(ops, dimension=1, num_keys=1)
+        self.sv, self.perm, self.bits = srt[0], srt[1], srt[2]
+        self.extra = srt[3] if extra_sn is not None else None
+        brk = self.sv[:, 1:] != self.sv[:, :-1]
+        self.newrun = jnp.concatenate([jnp.ones((S, 1), bool), brk], axis=1)
+        self.endrun = jnp.concatenate([brk, jnp.ones((S, 1), bool)], axis=1)
+
+    def bit(self, k):
+        return ((self.bits >> k) & 1).astype(bool)
+
+    def count(self, valid_sn_sorted):
+        f = valid_sn_sorted.astype(jnp.int32)
+        s = jnp.cumsum(f, axis=1)
+        ex_start = jax.lax.cummax(jnp.where(self.newrun, s - f, -1), axis=1)
+        s_end = jax.lax.cummin(jnp.where(self.endrun, s, jnp.int32(2**30)),
+                               axis=1, reverse=True)
+        return s_end - ex_start
+
+    def unsort(self, packed_sn):
+        _, out = jax.lax.sort((self.perm, packed_sn), dimension=1,
+                              num_keys=1)
+        return out.T
+
+
+def sorted_tally_round(cfg: Config, st: PbftState, r, *,
+                       telem: bool = False):
+    """The retired 3-sort round, verbatim."""
+    N, S = cfg.n_nodes, cfg.log_capacity
+    f = cfg.f
+    Q = 2 * f + 1
+    K = f + 1
+    seed = st.seed
+    ur = jnp.asarray(r, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    uidx = idx.astype(jnp.uint32)
+    sarange = jnp.arange(S, dtype=jnp.int32)
+
+    no_part = cfg.partition_cutoff == 0
+    bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
+    crash_on = cfg.crash_cutoff > 0
+    down = st.down
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,
+            cfg.max_crashed)
+        up = ~down
+        bcast = bcast & up
+    if not no_part:
+        part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
+                       < _lt(cfg.partition_cutoff))
+        side = (_draw(seed, rng.STREAM_PARTITION, ur, 1, uidx)
+                & jnp.uint32(1)).astype(jnp.int32)               # [N]
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+    honest = idx < (N - cfg.n_byzantine)
+    byz = ~honest
+
+    def side_ok(b):
+        return ~part_active | (side == b)
+
+    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
+    if equiv:
+        stance = (_draw(seed, rng.STREAM_EQUIV, ur, uidx,
+                        jnp.uint32(0x80000000)) & jnp.uint32(1)).astype(bool)
+
+    view, timer = st.view, st.timer
+    pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
+    prepared, committed, dval = st.prepared, st.committed, st.dval
+    if crash_on:
+        view = jnp.where(rec, 0, view)
+        timer = jnp.where(rec, 0, timer)
+        frozen = (view, timer, pp_seen, pp_view, pp_val, prepared,
+                  committed, dval)
+    committed_at_start = committed
+
+    # ---- P0 churn.
+    view = view + churn.astype(jnp.int32)
+    timer = jnp.where(churn, 0, timer)
+    reset = jnp.broadcast_to(churn, (N,))
+
+    # ---- P1 view catch-up via the retired batched full sort.
+    sender_v = honest & bcast
+    if no_part:
+        t = jnp.sort(jnp.where(sender_v, view, -1)[None, :], axis=1)
+        a1 = jnp.broadcast_to(t[0, N - K], (N,))                 # [N]
+        a2 = (jnp.broadcast_to(t[0, N - K + 1], (N,)) if K >= 2
+              else jnp.full((N,), I32_MAX, jnp.int32))
+    else:
+        cols = jnp.stack([jnp.where(sender_v & side_ok(0), view, -1),
+                          jnp.where(sender_v & side_ok(1), view, -1)])
+        t = jnp.sort(cols, axis=1)                               # ascending
+        a1 = t[:, N - K][side]                                   # [N]
+        a2 = (t[:, N - K + 1] if K >= 2
+              else jnp.full((2,), I32_MAX, jnp.int32))[side]
+    in_set = sender_v                                            # self side ok
+    vth = jnp.where(in_set, a1, jnp.clip(view, a1, a2))
+    catch = vth > view
+    view = jnp.where(catch, vth, view)
+    timer = jnp.where(catch, 0, timer)
+    reset |= catch
+
+    # ---- P2 timeout.
+    to = timer >= cfg.view_timeout
+    view = view + to.astype(jnp.int32)
+    timer = jnp.where(to, 0, timer)
+    reset |= to
+
+    # ---- P3 pre-prepare.
+    is_primary = honest & (view % N == idx)
+    fresh = jnp.min(jnp.where(~pp_seen, sarange[None, :], S), axis=1)
+    fresh_hot = (sarange[None, :] == fresh[:, None])
+    ppb = is_primary[:, None] & ((pp_seen & ~committed) | fresh_hot)
+    fresh_val = _i32(_draw(seed, rng.STREAM_VALUE,
+                           view[:, None].astype(jnp.uint32), 2,
+                           sarange[None, :].astype(jnp.uint32)))
+    msg_val = jnp.where(pp_seen, pp_val, fresh_val)
+
+    prim = view % N
+    if no_part:
+        prim_del = (prim == idx) | bcast[prim]
+    else:
+        prim_del = (prim == idx) | (bcast[prim]
+                                    & (~part_active | (side[prim] == side)))
+    prim_ok = prim_del & (view[prim] == view)
+    pm_b = ppb[prim]
+    pm_val = msg_val[prim]
+    if equiv:
+        prim_byz = byz[prim]
+        bval = _i32(_draw(seed, rng.STREAM_VALUE,
+                          view[:, None].astype(jnp.uint32),
+                          jnp.where(stance[prim], 4, 3)[:, None]
+                          .astype(jnp.uint32),
+                          sarange[None, :].astype(jnp.uint32)))
+        prim_ok = jnp.where(prim_byz, prim_del, prim_ok)
+        pm_b = pm_b | prim_byz[:, None]
+        pm_val = jnp.where(prim_byz[:, None], bval, pm_val)
+    accept = (prim_ok[:, None] & pm_b
+              & (~pp_seen | (pp_view < view[:, None]))
+              & (~prepared | (pm_val == pp_val)))
+    pp_view = jnp.where(accept, view[:, None], pp_view)
+    pp_val = jnp.where(accept, pm_val, pp_val)
+    pp_seen = pp_seen | accept
+
+    # ---- P4 + P5 tallies in sorted space with the retired unsort.
+    if equiv:
+        eq_send = byz & bcast & stance
+        if no_part:
+            extra = jnp.broadcast_to(jnp.sum(eq_send.astype(jnp.int32)),
+                                     (N,))
+        else:
+            extra = jnp.stack(
+                [jnp.sum((eq_send & side_ok(0)).astype(jnp.int32)),
+                 jnp.sum((eq_send & side_ok(1)).astype(jnp.int32))
+                 ])[side]                                        # [N]
+        extra = extra - (eq_send).astype(jnp.int32)
+        extra_sn = jnp.broadcast_to(extra[:, None], (N, S)).T
+    else:
+        extra_sn = None
+
+    def b32(x):
+        return x.astype(jnp.int32)
+
+    bits = (b32(pp_seen) | (b32(prepared) << 1) | (b32(committed) << 2)
+            | ((b32(honest) | (b32(bcast) << 1))[:, None] << 3))
+    if not no_part:
+        bits |= ((b32(side) | (b32(side_ok(0)) << 1)
+                  | (b32(side_ok(1)) << 2))[:, None] << 5)
+    if crash_on:
+        bits |= b32(up)[:, None] << 8
+    tal = _SortedTally(pp_val.T, bits.T, extra_sn)
+    pp_seen_s, prepared_s, committed_s = tal.bit(0), tal.bit(1), tal.bit(2)
+    honest_s, bcast_s = tal.bit(3), tal.bit(4)
+    hb_s = honest_s & bcast_s
+    extra_s = jnp.int32(0) if tal.extra is None else tal.extra
+
+    def counts_for_s(relevant_s):
+        if no_part:
+            cnt = tal.count(hb_s & relevant_s)
+        else:
+            c0 = tal.count(hb_s & tal.bit(6) & relevant_s)
+            c1 = tal.count(hb_s & tal.bit(7) & relevant_s)
+            cnt = jnp.where(tal.bit(5), c1, c0)
+        self_adj = (honest_s & relevant_s & ~bcast_s).astype(jnp.int32)
+        return cnt + self_adj + extra_s
+
+    # ---- P4 prepare tally.
+    c4 = counts_for_s(pp_seen_s)
+    prep_hit_s = pp_seen_s & (c4 >= Q)
+    if crash_on:
+        prep_hit_s &= tal.bit(8)
+    prep_new_s = prep_hit_s & ~prepared_s       # telemetry (DCE'd when off)
+    prep_miss_s = pp_seen_s & ~prepared_s & ~prep_hit_s
+    prepared2_s = prepared_s | prep_hit_s
+
+    # ---- P5 commit tally.
+    c5 = counts_for_s(prepared2_s)
+    commit_now_s = prepared2_s & (c5 >= Q) & ~committed_s
+    if crash_on:
+        commit_now_s &= tal.bit(8)
+    commit_miss_s = prepared2_s & ~committed_s & (c5 < Q)  # telemetry
+
+    packed = tal.unsort(b32(prepared2_s) | (b32(commit_now_s) << 1))
+    prepared = (packed & 1).astype(bool)
+    commit_now = (packed >> 1).astype(bool)
+    dval = jnp.where(commit_now, pp_val, dval)
+    committed = committed | commit_now
+
+    # ---- P6 decide gossip.
+    dec = honest[:, None] & bcast[:, None] & committed            # [N, S]
+    if no_part:
+        src = jnp.where(dec, idx[:, None], N)
+        imin_rows = jnp.min(src, axis=0)[None, :]                 # [1, S]
+        imin = jnp.broadcast_to(imin_rows, (N, S))
+    else:
+        rows = []
+        for b in (0, 1):
+            src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
+            rows.append(jnp.min(src, axis=0))                     # [S]
+        imin_rows = jnp.stack(rows)                               # [2, S]
+        imin = imin_rows[side]                                    # [N, S]
+    adopt = (imin < N) & ~committed
+    if crash_on:
+        adopt &= up[:, None]
+    val_rows = dval[jnp.clip(imin_rows, 0, N - 1),
+                    sarange[None, :]]                             # [1|2, S]
+    vfull = (jnp.broadcast_to(val_rows, (N, S)) if no_part
+             else val_rows[side])
+    dval = jnp.where(adopt, vfull, dval)
+    committed = committed | adopt
+
+    # ---- P7 timer.
+    new_commit = jnp.any(committed & ~committed_at_start, axis=1)
+    timer = jnp.where(reset | new_commit, jnp.where(new_commit, 0, timer),
+                      timer + 1)
+
+    if crash_on:
+        (view, timer, pp_seen, pp_view, pp_val, prepared, committed,
+         dval) = freeze_down(
+            down, frozen, (view, timer, pp_seen, pp_view, pp_val,
+                           prepared, committed, dval))
+
+    new = PbftState(seed, view, timer, pp_seen, pp_view, pp_val,
+                    prepared, committed, dval, down)
+    if not telem:
+        return new
+    cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    vec = jnp.stack([cnt(prep_new_s), cnt(prep_miss_s), cnt(commit_now_s),
+                     cnt(commit_miss_s), cnt(adopt),
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
+    return new, vec
+
+
+def sorted_tally_round_telem(cfg: Config, st: PbftState, r):
+    return sorted_tally_round(cfg, st, r, telem=True)
+
+
+def reference_engine() -> EngineDef:
+    """The retired round behind the production EngineDef seam, so tests
+    drive it through the same runner/chunk machinery as the real one."""
+    return EngineDef("pbft-bcast-retired", pbft_init, sorted_tally_round,
+                     _extract, _pspec, telemetry_names=PBFT_TELEMETRY,
+                     round_telem=sorted_tally_round_telem)

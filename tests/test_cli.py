@@ -130,9 +130,11 @@ def test_cli_fsweep_schema_stable(capsys):
     sweep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert set(sweep) == {
         "protocol", "engine", "platform", "f_sweep", "n_elements",
-        "n_rounds", "seed", "steps", "wall_s", "steps_per_sec",
-        "compile_s_one_program", "payload_bytes", "digest"}
+        "n_rounds", "n_sweeps", "fault_model", "seed", "steps", "wall_s",
+        "steps_per_sec", "compile_s_one_program", "payload_bytes",
+        "rung_digests", "digest"}
     assert sweep["n_elements"] == 2 and len(sweep["digest"]) == 64
+    assert len(sweep["rung_digests"]) == 2
     assert sweep["compile_s_one_program"] > 0
 
 
@@ -159,12 +161,52 @@ def test_cli_fsweep_requires_pbft_tpu():
                   "--f-sweep", "1..4"])
 
 
-def test_cli_fsweep_rejects_bcast_fault_model():
-    # The sweep path runs the dense SPEC §6 kernel; silently returning
-    # edge-model results for a §6b request would mislabel the run.
+def test_cli_fsweep_bcast_ladder_matches_individual_runs(capsys):
+    """The lifted carve-outs (VERDICT weak #5): a `--fault-model bcast
+    --f-sweep 1,2,4 --sweeps 2` ladder runs as ONE compiled padded
+    program whose per-rung digests equal standalone runs through BOTH
+    front doors — the Python CLI's tpu engine and the native binary's
+    cpu oracle (f=fs[k], seed=seed+k, n_sweeps=2 each)."""
+    fs = [1, 2, 4]
+    base = ["--protocol", "pbft", "--fault-model", "bcast", "--rounds",
+            "24", "--log-capacity", "8", "--drop-rate", "0.1",
+            "--partition-rate", "0.05", "--sweeps", "2", "--seed", "7"]
+    rc = cli.main(base + ["--engine", "tpu", "--f-sweep", "1,2,4"])
+    assert rc == 0
+    sweep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert sweep["fault_model"] == "bcast" and sweep["n_sweeps"] == 2
+
+    expected = b""
+    for k, f in enumerate(fs):
+        rung = ["--protocol", "pbft", "--fault-model", "bcast", "--f",
+                str(f), "--rounds", "24", "--log-capacity", "8",
+                "--drop-rate", "0.1", "--partition-rate", "0.05",
+                "--sweeps", "2", "--seed", str(7 + k)]
+        rc = cli.main(rung + ["--engine", "tpu"])
+        assert rc == 0
+        ours = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert sweep["rung_digests"][k] == ours["digest"], (k, f)
+        # Same rung through the native front door (cpu oracle engine).
+        native = _run_native(rung)
+        assert sweep["rung_digests"][k] == native["digest"], (k, f)
+        from consensus_tpu.core.config import Config
+        from consensus_tpu.network import simulator
+        cfg = Config(protocol="pbft", fault_model="bcast", f=f,
+                     n_nodes=3 * f + 1, n_rounds=24, log_capacity=8,
+                     drop_rate=0.1, partition_rate=0.05, n_sweeps=2,
+                     seed=7 + k)
+        expected += simulator.run(cfg, warmup=False).payload
+    assert sweep["digest"] == hashlib.sha256(expected).hexdigest()
+    assert sweep["payload_bytes"] == len(expected)
+    assert sweep["steps"] == sum(3 * f + 1 for f in fs) * 24 * 2
+
+
+def test_cli_fsweep_rejects_byz_above_smallest_rung():
+    # A rung below n_byzantine has no valid standalone twin (pbft
+    # requires n_byzantine <= f) — fail in arg validation, not later.
     with pytest.raises(SystemExit):
-        cli.main(["--protocol", "pbft", "--engine", "tpu",
-                  "--fault-model", "bcast", "--f-sweep", "1,2"])
+        cli.main(["--protocol", "pbft", "--engine", "tpu", "--f", "2",
+                  "--n-byzantine", "2", "--f-sweep", "1,2,4"])
 
 
 def test_cli_rejects_tpu_flags_on_cpu_engine():

@@ -127,6 +127,66 @@ def test_sort_budget_overrun_fires():
     assert any("> budget 1" in v.message for v in viols)
 
 
+def test_retired_tally_round_exceeds_new_lowered_ceilings():
+    """The sort-diet regression gate bites at its NEW level: the
+    retired pre-diet round (3 sorts + the cumsum/cummax/cummin
+    brackets, tests/reference_pbft_bcast.py) compiled through the
+    PRODUCTION chunk jit at the flagship shape violates the LOWERED
+    pbft-bcast ceilings (sort_budget 1, cumsum_budget 20) — proving the
+    tightened ceiling fires on precisely the program it retired, not
+    just on the old 3/33 one."""
+    from reference_pbft_bcast import reference_engine
+
+    from benchmarks.run_benchmarks import CONFIGS
+
+    cfg = CONFIGS["pbft-100k-bcast"]
+    eng = reference_engine()
+    rep = hlo.compiled_report(cfg, eng)
+    assert rep.sort_ops == 3 and rep.cumsum_ops > 20
+    con = contracts.program_contracts()["pbft-bcast"]
+    assert con.sort_budget == 1 and con.cumsum_budget == 20
+    viols = contracts.check_module(
+        rep, con, cfg, mode=None, axis=None,
+        carry_leaves=hlo.n_carry_leaves(cfg, eng))
+    assert _contracts_hit(viols) == {"sort_budget"}
+    assert any("3 sort-class ops > budget 1" in v.message for v in viols)
+    assert any("> budget 20" in v.message for v in viols)
+
+
+def test_strided_reduce_windows_not_counted_as_cumsum():
+    """The classifier refinement behind the lowered ceilings: plain
+    reductions lower on CPU as TILED reduce-window cascades
+    (stride > 1) and must land in the reduce class; only unit-stride
+    prefix-scan windows count against the cumsum budget."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.ShapeDtypeStruct((16, 100000), jnp.int32)
+    scan = hlo.analyze(jax.jit(
+        lambda a: jnp.cumsum(a, axis=1)).lower(x).compile().as_text())
+    red = hlo.analyze(jax.jit(
+        lambda a: jnp.sum(a, axis=1)).lower(x).compile().as_text())
+    assert scan.cumsum_ops > 0
+    assert red.cumsum_ops == 0
+    assert red.ops.get("reduce-window-strided", 0) > 0
+
+
+def test_fsweep_target_contract_pinned():
+    """The pbft-100k-bcast-fsweep registry entry lowers the EXACT
+    one-program padded ladder `--fault-model bcast --f-sweep`
+    dispatches and holds it to the pbft-bcast ceilings (one sort per
+    round, scan brackets within budget, no collectives, no host
+    boundary) at the flagship N_pad = 100k shape."""
+    tgt = registry.target("pbft-100k-bcast-fsweep")
+    assert tgt.fsweep and 3 * max(tgt.fsweep) + 1 == 100_000
+    rep = hlo.fsweep_compiled_report(tgt.cfg, tgt.fsweep)
+    con = contracts.program_contracts()["pbft-bcast"]
+    viols = contracts.check_module(rep, con, tgt.cfg, mode=None,
+                                   axis=None, carry_leaves=0)
+    assert viols == []
+    assert rep.sort_ops == 1
+
+
 def test_undonated_carry_fires_donation():
     viols = _violations(bad_engines.ok_engine,
                         jit_fn=bad_engines.undonated_chunk)

@@ -99,3 +99,75 @@ def test_fault_model_validation():
         Config(protocol="raft", n_nodes=5, fault_model="bcast")
     with pytest.raises(ValueError):
         _cfg(fault_model="nonsense")
+
+
+# --- sort-diet bit-identity vs the retired 3-sort round ----------------------
+#
+# The aggregate round (ONE payload sort, binary-search P1 order
+# statistics, top-M run-table delivery) must reproduce the retired
+# `_SortedTally` round — kept verbatim as a test-only reference
+# (tests/reference_pbft_bcast.py) — on every state leaf AND telemetry
+# counter, across the adversary grid and the populations the engine
+# exists for. (N = 2047, not 2048: pbft requires n_nodes = 3f+1.)
+
+DIET_CONFIGS = [
+    ("N64-part-hostile", _cfg(f=21, n_nodes=64, n_rounds=24,
+                              log_capacity=8, drop_rate=0.2,
+                              partition_rate=0.2, churn_rate=0.05)),
+    ("N64-byz-silent", _cfg(f=21, n_nodes=64, n_rounds=24, log_capacity=8,
+                            n_byzantine=10, partition_rate=0.1)),
+    ("N64-byz-equiv", _cfg(f=21, n_nodes=64, n_rounds=24, log_capacity=8,
+                           n_byzantine=21, byz_mode="equivocate",
+                           drop_rate=0.2, partition_rate=0.1, seed=31)),
+    ("N64-crash", _cfg(f=21, n_nodes=64, n_rounds=24, log_capacity=8,
+                       crash_prob=0.1, recover_prob=0.3, max_crashed=8,
+                       partition_rate=0.1)),
+    ("N1501", _cfg(f=500, n_nodes=1501, n_rounds=8, log_capacity=8,
+                   n_sweeps=1, drop_rate=0.05, seed=3)),
+    ("N2047-equiv-crash-part", _cfg(f=682, n_nodes=2047, n_rounds=6,
+                                    log_capacity=8, n_sweeps=1,
+                                    n_byzantine=100, byz_mode="equivocate",
+                                    drop_rate=0.1, partition_rate=0.3,
+                                    churn_rate=0.1, crash_prob=0.05,
+                                    recover_prob=0.2, seed=13)),
+]
+
+
+@pytest.mark.parametrize("tag,cfg", DIET_CONFIGS,
+                         ids=[t for t, _ in DIET_CONFIGS])
+def test_diet_round_bit_identical_to_retired_round(tag, cfg):
+    import sys
+    import pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).parent))
+    from reference_pbft_bcast import reference_engine
+
+    from consensus_tpu.engines import pbft_bcast
+    from consensus_tpu.network import runner
+
+    new_stats, ref_stats = {}, {}
+    new = runner.run(cfg, pbft_bcast.get_engine(), stats=new_stats,
+                     telemetry=True)
+    ref = runner.run(cfg, reference_engine(), stats=ref_stats,
+                     telemetry=True)
+    for key in ref:
+        np.testing.assert_array_equal(new[key], ref[key], err_msg=(tag, key))
+    for name, vals in ref_stats["telemetry"].items():
+        np.testing.assert_array_equal(new_stats["telemetry"][name], vals,
+                                      err_msg=(tag, name))
+
+
+def test_diet_round_scan_chunk_invariant():
+    """The diet round under the production chunked scan: chunking must
+    not change a single leaf (the runner contract every engine obeys —
+    re-pinned here because the round was rewritten)."""
+    import dataclasses
+
+    from consensus_tpu.engines import pbft_bcast
+    from consensus_tpu.network import runner
+
+    cfg = _cfg(f=2, n_rounds=24)
+    one = runner.run(cfg, pbft_bcast.get_engine())
+    chunked = runner.run(dataclasses.replace(cfg, scan_chunk=7),
+                         pbft_bcast.get_engine())
+    for key in one:
+        np.testing.assert_array_equal(one[key], chunked[key], err_msg=key)

@@ -1,9 +1,12 @@
-"""Batched PBFT f-sweep vs the unpadded engine and the C++ oracle.
+"""Batched PBFT f-sweep vs the unpadded engines and the C++ oracle.
 
 The padding argument (engines/pbft_sweep.py): RNG draws are keyed by
 absolute ids, never by N, so a padded sweep element must be *identical*
 — not just equivalent — to the dedicated (N = 3f+1)-shaped program and
-to the scalar oracle.
+to the scalar oracle. Covered for BOTH fault models (the dense SPEC §6
+round and the §6b bcast aggregate round — the former `--f-sweep`
+carve-out, VERDICT weak #5) and for the independent-sweeps axis
+(rung k sweep j == standalone run f=fs[k], seed=seed+k, sweep j).
 """
 import dataclasses
 
@@ -17,7 +20,26 @@ from consensus_tpu.oracle import bindings
 
 BASE = Config(protocol="pbft", f=1, n_nodes=4, n_rounds=24, log_capacity=8,
               seed=7, drop_rate=0.15, partition_rate=0.05, churn_rate=0.05)
+BCAST = dataclasses.replace(BASE, fault_model="bcast")
 FS = [1, 2, 4]
+
+
+def _rung_cfg(base, f, k, n_sweeps=1):
+    return dataclasses.replace(base, f=f, n_nodes=3 * f + 1,
+                               n_sweeps=n_sweeps, seed=base.seed + k)
+
+
+def _assert_rung_equal(rung, exact):
+    """Padded rung output ([K, n, S] arrays) vs a standalone batched
+    run. dval is decided-log content only where committed (the
+    serializer packs exactly those slots — core/serialize.py);
+    elsewhere it is engine-internal scratch and may legitimately
+    differ."""
+    np.testing.assert_array_equal(rung["committed"], exact["committed"])
+    c = rung["committed"].astype(bool)
+    np.testing.assert_array_equal(rung["dval"][c].astype(np.uint32),
+                                  exact["dval"][c].astype(np.uint32))
+    np.testing.assert_array_equal(rung["view"], exact["view"])
 
 
 @pytest.fixture(scope="module")
@@ -25,49 +47,69 @@ def sweep():
     return pbft_fsweep_run(BASE, FS)
 
 
+@pytest.fixture(scope="module")
+def bcast_sweep():
+    return pbft_fsweep_run(BCAST, FS)
+
+
 @pytest.mark.parametrize("k", range(len(FS)))
 def test_padded_equals_unpadded_engine(sweep, k):
-    f = FS[k]
-    cfg = dataclasses.replace(BASE, f=f, n_nodes=3 * f + 1, n_sweeps=1,
-                              seed=BASE.seed + k)
-    exact = pbft_run(cfg)
-    np.testing.assert_array_equal(sweep[k]["committed"], exact["committed"][0])
-    # dval is decided-log content only where committed (the serializer
-    # packs exactly those slots — core/serialize.py); elsewhere it is
-    # engine-internal scratch and may legitimately differ.
-    c = sweep[k]["committed"]
-    np.testing.assert_array_equal(sweep[k]["dval"][c].astype(np.uint32),
-                                  exact["dval"][0][c].astype(np.uint32))
-    np.testing.assert_array_equal(sweep[k]["view"], exact["view"][0])
+    exact = pbft_run(_rung_cfg(BASE, FS[k], k))
+    _assert_rung_equal(sweep[k], exact)
 
 
 @pytest.mark.parametrize("k", range(len(FS)))
 def test_padded_equals_oracle(sweep, k):
-    f = FS[k]
-    cfg = dataclasses.replace(BASE, f=f, n_nodes=3 * f + 1, n_sweeps=1,
-                              seed=BASE.seed + k)
-    oracle = bindings.pbft_run(cfg)
+    oracle = bindings.pbft_run(_rung_cfg(BASE, FS[k], k))
     c = oracle["committed"].astype(bool)
-    np.testing.assert_array_equal(sweep[k]["committed"], c)
-    np.testing.assert_array_equal(sweep[k]["dval"][c].astype(np.uint32),
+    np.testing.assert_array_equal(sweep[k]["committed"][0], c)
+    np.testing.assert_array_equal(sweep[k]["dval"][0][c].astype(np.uint32),
                                   oracle["dval"][c].astype(np.uint32))
+
+
+@pytest.mark.parametrize("k", range(len(FS)))
+def test_bcast_padded_equals_unpadded_engine(bcast_sweep, k):
+    """The §6b aggregate round with traced (n_real, f) must reproduce
+    the dedicated engines/pbft_bcast.py program byte-for-byte."""
+    exact = pbft_run(_rung_cfg(BCAST, FS[k], k))
+    _assert_rung_equal(bcast_sweep[k], exact)
+
+
+@pytest.mark.parametrize("k", range(len(FS)))
+def test_bcast_padded_equals_oracle(bcast_sweep, k):
+    oracle = bindings.pbft_run(_rung_cfg(BCAST, FS[k], k))
+    c = oracle["committed"].astype(bool)
+    np.testing.assert_array_equal(bcast_sweep[k]["committed"][0], c)
+    np.testing.assert_array_equal(
+        bcast_sweep[k]["dval"][0][c].astype(np.uint32),
+        oracle["dval"][c].astype(np.uint32))
+
+
+@pytest.mark.parametrize("base", [BASE, BCAST], ids=["edge", "bcast"])
+def test_padded_sweeps_axis_equals_standalone(base):
+    """The lifted --sweeps carve-out: K instances per rung as extra
+    lanes — rung k must equal a standalone n_sweeps=K run (whose seed
+    vector is lo32(seed + k + j), docs/SPEC.md §1), for both fault
+    models."""
+    multi = dataclasses.replace(base, n_sweeps=3)
+    out = pbft_fsweep_run(multi, [1, 2])
+    for k, f in enumerate([1, 2]):
+        exact = pbft_run(_rung_cfg(base, f, k, n_sweeps=3))
+        assert out[k]["committed"].shape[0] == 3
+        _assert_rung_equal(out[k], exact)
 
 
 def test_padded_equivocate_equals_unpadded():
     """The equivocating adversary must survive padding byte-identically
-    (its draws are keyed by absolute ids, like every other stream)."""
-    base = dataclasses.replace(BASE, n_byzantine=1, byz_mode="equivocate",
-                               churn_rate=0.2)
-    out = pbft_fsweep_run(base, [1, 2])
-    for k, f in enumerate([1, 2]):
-        cfg = dataclasses.replace(base, f=f, n_nodes=3 * f + 1, n_sweeps=1,
-                                  seed=base.seed + k)
-        exact = pbft_run(cfg)
-        np.testing.assert_array_equal(out[k]["committed"],
-                                      exact["committed"][0])
-        c = out[k]["committed"]
-        np.testing.assert_array_equal(out[k]["dval"][c].astype(np.uint32),
-                                      exact["dval"][0][c].astype(np.uint32))
+    (its draws are keyed by absolute ids, like every other stream) —
+    under both fault granularities."""
+    for fault_base in (BASE, BCAST):
+        base = dataclasses.replace(fault_base, n_byzantine=1,
+                                   byz_mode="equivocate", churn_rate=0.2)
+        out = pbft_fsweep_run(base, [1, 2])
+        for k, f in enumerate([1, 2]):
+            exact = pbft_run(_rung_cfg(base, f, k))
+            _assert_rung_equal(out[k], exact)
 
 
 def test_padded_equivocate_f8_and_up(  # VERDICT r3 #5: ladder coverage
@@ -81,23 +123,34 @@ def test_padded_equivocate_f8_and_up(  # VERDICT r3 #5: ladder coverage
     fs = [8, 16]
     out = pbft_fsweep_run(base, fs)
     for k, f in enumerate(fs):
-        cfg = dataclasses.replace(base, f=f, n_nodes=3 * f + 1, n_sweeps=1,
-                                  seed=base.seed + k)
+        cfg = _rung_cfg(base, f, k)
         exact = pbft_run(cfg)
-        np.testing.assert_array_equal(out[k]["committed"],
-                                      exact["committed"][0])
-        c = out[k]["committed"]
-        np.testing.assert_array_equal(out[k]["dval"][c].astype(np.uint32),
-                                      exact["dval"][0][c].astype(np.uint32))
+        _assert_rung_equal(out[k], exact)
+        c = out[k]["committed"][0]
         oracle = bindings.pbft_run(cfg)
         np.testing.assert_array_equal(c, oracle["committed"].astype(bool))
-        np.testing.assert_array_equal(out[k]["dval"][c].astype(np.uint32),
+        np.testing.assert_array_equal(out[k]["dval"][0][c].astype(np.uint32),
                                       oracle["dval"][c].astype(np.uint32))
         assert c.any(), f"f={f} equivocate sweep committed nothing"
 
 
-def test_liveness_across_fs(sweep):
+def test_fsweep_validation():
+    """Ladder guards fail fast: crash configs (§6c unmodeled), rungs
+    below 1, and byz counts no rung can satisfy."""
+    with pytest.raises(ValueError, match="crash-recover"):
+        pbft_fsweep_run(dataclasses.replace(BASE, crash_prob=0.1,
+                                            recover_prob=0.5), [1, 2])
+    with pytest.raises(ValueError, match=">= 1"):
+        pbft_fsweep_run(BASE, [0, 1])
+    with pytest.raises(ValueError, match="n_byzantine"):
+        pbft_fsweep_run(dataclasses.replace(BASE, f=2, n_nodes=7,
+                                            n_byzantine=2), [1, 2])
+
+
+def test_liveness_across_fs(sweep, bcast_sweep):
     # Every element of the sweep must actually commit something under this
     # mild adversary — otherwise the sweep benchmark measures idling.
-    for k, out in enumerate(sweep):
-        assert out["committed"].any(), f"f={FS[k]} committed nothing"
+    for tag, out in (("edge", sweep), ("bcast", bcast_sweep)):
+        for k, o in enumerate(out):
+            assert o["committed"].any(), \
+                f"{tag} f={FS[k]} committed nothing"

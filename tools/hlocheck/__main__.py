@@ -72,12 +72,16 @@ def run_checks(only: list[str] | None = None, update: bool = False) -> int:
         from consensus_tpu.network import simulator
         eng = simulator.engine_def(tgt.cfg)
         con = cons[eng.name]
-        leaves = hlo.n_carry_leaves(tgt.cfg, eng)
+        # f-ladder targets are ONE dispatch (no chunked cross-dispatch
+        # carry), so their donation contract is trivially zero leaves.
+        leaves = 0 if tgt.fsweep else hlo.n_carry_leaves(tgt.cfg, eng)
         variants: dict[str, dict] = {}
         bad = False
         for var in tgt.variants:
             t0 = time.perf_counter()
-            rep = hlo.compiled_report(tgt.cfg, eng, var.mesh_shape)
+            rep = (hlo.fsweep_compiled_report(tgt.cfg, tgt.fsweep)
+                   if tgt.fsweep
+                   else hlo.compiled_report(tgt.cfg, eng, var.mesh_shape))
             viol = contracts.check_module(
                 rep, con, tgt.cfg, mode=var.mode, axis=var.axis,
                 carry_leaves=leaves,

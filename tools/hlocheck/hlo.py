@@ -41,10 +41,19 @@ _ALIAS_RE = re.compile(
     r"\{([\d,]*)\}:\s*\((\d+),\s*\{[\d,]*\},?\s*(?:may|must)-alias\)")
 
 SORT_OPS = frozenset({"sort"})
-# The engines' only reduce-window producers are the cumulative ops
-# (cumsum/cummax/cummin brackets — docs/PERF.md "sort diet"); top-k has
-# no custom-call lowering here and lands in the sort class.
+# Cumsum-class = PREFIX-SCAN reduce-windows only (cumsum/cummax/cummin
+# brackets — docs/PERF.md "sort diet"): their windows slide with unit
+# stride (`size=1x1x16 pad=..x15_0` cascade stages). The CPU backend
+# ALSO lowers large plain reductions (an ordinary ``jnp.sum``) as
+# reduce-window cascades, but those windows are tiled — ``stride=1x32``
+# — and a plain reduction is a single bandwidth-benign pass, not a scan
+# bracket (on TPU it lowers as a plain reduce); ``analyze`` re-labels
+# strided reduce-windows ``reduce-window-strided`` so they land in the
+# reduce class, not against the cumsum budget. Top-k has no custom-call
+# lowering here and lands in the sort class.
 CUMSUM_OPS = frozenset({"reduce-window"})
+_WINDOW_RE = re.compile(r"window=\{([^}]*)\}")
+_STRIDE_RE = re.compile(r"stride=([\dx]+)")
 COLLECTIVE_OPS = frozenset({
     "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
     "collective-permute", "collective-broadcast"})
@@ -66,7 +75,8 @@ _CLASS_PATTERNS: tuple[tuple[str, frozenset[str]], ...] = (
     ("custom-call", frozenset({"custom-call"})),
     ("gather", frozenset({"gather", "dynamic-slice"})),
     ("scatter", frozenset({"scatter", "dynamic-update-slice"})),
-    ("reduce", frozenset({"reduce", "reduce-precision"})),
+    ("reduce", frozenset({"reduce", "reduce-precision",
+                          "reduce-window-strided"})),
     ("rng", frozenset({"rng", "rng-bit-generator", "rng-get-and-update-state"})),
     ("control", frozenset({"while", "conditional", "call", "fusion"})),
     ("data", frozenset({
@@ -123,6 +133,17 @@ def _max_elems(type_segment: str) -> int:
     return best
 
 
+def _scan_window(rest_of_line: str) -> bool:
+    """True when a reduce-window instruction's window slides with unit
+    stride — the prefix-scan (cumsum-class) form. Tiled windows
+    (any stride component > 1) are reduction cascade stages."""
+    w = _WINDOW_RE.search(rest_of_line)
+    if not w:
+        return True
+    s = _STRIDE_RE.search(w.group(1))
+    return s is None or set(s.group(1).split("x")) <= {"1"}
+
+
 def analyze(txt: str) -> ModuleReport:
     """Parse one compiled module's text into a :class:`ModuleReport`."""
     ops: Counter = Counter()
@@ -130,6 +151,11 @@ def analyze(txt: str) -> ModuleReport:
     host_ops: list[str] = []
     for m in _INSTR_RE.finditer(txt):
         type_seg, op = m.group(1), m.group(2)
+        if op == "reduce-window":
+            eol = txt.find("\n", m.end())
+            rest = txt[m.end():eol if eol != -1 else len(txt)]
+            if not _scan_window(rest):
+                op = "reduce-window-strided"
         ops[op] += 1
         if op in COLLECTIVE_OPS:
             collectives.setdefault(op, []).append(_max_elems(type_seg))
@@ -203,6 +229,22 @@ def compiled_report(cfg, eng=None, mesh_shape=None, *, jit_fn=None,
                     mesh=None) -> ModuleReport:
     return analyze(compiled_text(cfg, eng, mesh_shape, jit_fn=jit_fn,
                                  mesh=mesh))
+
+
+def fsweep_compiled_text(cfg, fs) -> str:
+    """Compiled HLO text of the one-program padded f-ladder — the exact
+    ``engines/pbft_sweep._fsweep_jit`` program ``--f-sweep`` dispatches,
+    lowered over ShapeDtypeStructs (trace time only). Like the chunk
+    program it is ONE ``while`` loop whose body is the padded round, so
+    module-wide op counts are per-round counts; unlike it, a ladder is
+    a single dispatch with no cross-dispatch carry, so the donation
+    contract is checked at zero carry leaves."""
+    from consensus_tpu.engines import pbft_sweep
+    return pbft_sweep.fsweep_lower(cfg, fs).compile().as_text()
+
+
+def fsweep_compiled_report(cfg, fs) -> ModuleReport:
+    return analyze(fsweep_compiled_text(cfg, fs))
 
 
 def compiled_collectives(cfg, mesh_shape, eng=None) -> dict[str, list[int]]:

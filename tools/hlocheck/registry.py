@@ -56,6 +56,12 @@ class Target:
     name: str
     cfg: Config
     variants: tuple[Variant, ...]
+    # Non-None = an f-LADDER target: lower the one-program padded sweep
+    # (engines/pbft_sweep.fsweep_lower over these rungs) instead of the
+    # chunked round loop. A ladder is ONE dispatch — no cross-dispatch
+    # carry exists, so its donation contract sees zero carry leaves by
+    # construction (tools/hlocheck/__main__).
+    fsweep: tuple[int, ...] | None = None
 
 
 SINGLE = Variant("single", None, None)
@@ -70,6 +76,18 @@ CAPPED_1K = Config(protocol="raft", n_nodes=1024, n_rounds=8, n_sweeps=2,
 PBFT_1K_DENSE = Config(protocol="pbft", f=341, n_nodes=1024, n_rounds=32,
                        n_sweeps=2, log_capacity=16, seed=3, **ADV)
 
+# The one-program §6b f-ladder at the flagship population: rungs pad to
+# N_pad = 3·33333+1 = 100k, the pbft-100k-bcast shape — so the program
+# that serves `--fault-model bcast --f-sweep ...` (the lifted carve-out,
+# VERDICT weak #5) is contract-pinned at trace time like every other
+# flagship program. Base config mirrors the CLI's (`args_to_config`
+# with the ladder's rates); engines/pbft_sweep.fsweep_lower swaps in
+# the padded shape and the per-(rung, sweep) lane axis.
+FSWEEP_BCAST_FS = (8333, 16666, 33333)
+PBFT_BCAST_FSWEEP = Config(protocol="pbft", fault_model="bcast", f=1,
+                           n_nodes=4, n_rounds=64, n_sweeps=1,
+                           log_capacity=16, seed=7, **ADV)
+
 
 def targets() -> tuple[Target, ...]:
     F = FLAGSHIP_CONFIGS
@@ -80,6 +98,8 @@ def targets() -> tuple[Target, ...]:
                (SINGLE, Variant("node2x4", (2, 4), "bounded", "node"),
                 SWEEP8)),
         Target("pbft-100k-bcast", F["pbft-100k-bcast"], (SINGLE, SWEEP8)),
+        Target("pbft-100k-bcast-fsweep", PBFT_BCAST_FSWEEP, (SINGLE,),
+               fsweep=FSWEEP_BCAST_FS),
         Target("paxos-10kx10k", F["paxos-10kx10k"], (SINGLE,)),
         Target("dpos-100k", F["dpos-100k"],
                (SINGLE, Variant("node1x8", (1, 8), "zero", "node"))),

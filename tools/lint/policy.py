@@ -23,7 +23,12 @@ DEVICE_SCOPE = ("consensus_tpu/engines", "consensus_tpu/ops")
 HOST_EXEMPT = {
     "consensus_tpu/engines/pbft_sweep.py": frozenset({
         "pbft_fsweep_timed", "_fsweep_slice", "_fsweep_device",
-        "fsweep_payload", "pbft_fsweep_run"}),
+        "fsweep_payload", "rung_payloads", "pbft_fsweep_run",
+        # Host-side ladder validation + static compile parameters
+        # (padded config, bcast table width) shared by the dispatch
+        # path and hlocheck's trace-time lowering — all inputs are
+        # host ints/Config, nothing is traced.
+        "_fsweep_static", "fsweep_lower"}),
     "consensus_tpu/engines/dpos.py": frozenset({"lib_index", "dpos_run"}),
 }
 
