@@ -45,6 +45,7 @@ _FLAG_FIELDS = {
     "epoch_len": ("epoch_len", 16),
     "scan_chunk": ("scan_chunk", 0),
     "sweep_chunk": ("sweep_chunk", 0),
+    "telemetry_window": ("telemetry_window", 0),
 }
 _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
                "fault_model": str, "drop_rate": float,
@@ -62,6 +63,7 @@ _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
 NATIVE_CLI_TPU_ONLY = frozenset({
     "mesh_shape", "scan_chunk", "sweep_chunk",
     "crash_prob", "recover_prob", "max_crashed",
+    "telemetry_window",
 })
 
 
@@ -154,7 +156,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "— docs/OBSERVABILITY.md)")
     ap.add_argument("-v", "--verbose", action="count", default=0,
                     help="print checkpoint-IO timings and telemetry "
-                         "totals to stderr")
+                         "totals to stderr, plus a live per-chunk "
+                         "progress line (current-window commit rate + "
+                         "ETA, backed by the rounds_completed/sim_eta_s "
+                         "gauges)")
     ap.add_argument("--config", default="",
                     help="JSON config file; typed flags override its values")
     ap.add_argument("--platform", default="auto",
@@ -269,6 +274,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     cfg = args_to_config(args)
 
+    if cfg.telemetry_window > 0 and not args.telemetry:
+        # The window ring IS the telemetry counters, windowed —
+        # --telemetry-window implies --telemetry rather than silently
+        # recording nothing (docs/OBSERVABILITY.md §"Flight recorder").
+        args.telemetry = True
+
     if cfg.engine != "tpu":
         # TPU-engine-only features must not be silently ignored. Name the
         # actual source: a typed flag, or a field inherited via --config.
@@ -345,6 +356,7 @@ def main(argv=None) -> int:
             ("--retries/--deadline/--fallback-cpu", supervise),
             ("--crash-prob", cfg.crash_prob > 0),
             ("--telemetry", args.telemetry),
+            ("--telemetry-window", cfg.telemetry_window > 0),
         ] if on]
         if unsupported:
             parser.error(f"{', '.join(unsupported)}: not supported with "
@@ -393,7 +405,8 @@ def main(argv=None) -> int:
         in_flight = sys.exc_info()[0] is not None
         try:
             if args.metrics_out:
-                _write_metrics(args, report_holder.get("run_report"))
+                _write_metrics(args, report_holder.get("run_report"),
+                               report_holder.get("flight"))
         except OSError as exc:
             if not in_flight:
                 raise
@@ -403,19 +416,31 @@ def main(argv=None) -> int:
             obs_trace.close()
 
 
-def _write_metrics(args, run_report: dict | None) -> None:
+def _write_metrics(args, run_report: dict | None,
+                   flight: dict | None = None) -> None:
     """--metrics-out: snapshot the registry (JSON, or Prometheus text
     for a .prom path); a supervised run's RunReport lands next to it.
-    Called from main's finally, so failing runs get their artifacts
-    too."""
+    A flight-recorder run's windowed series + latency histograms are
+    embedded as the ``"flight"`` block — the artifact
+    ``tools/teleview`` (obs/timeline.py) loads. Prometheus text cannot
+    carry the series, so a ``.prom`` path writes them to a
+    ``<stem>.flight.json`` sidecar instead of silently dropping what
+    the run spent device time recording. Called from main's finally,
+    so failing runs get their artifacts too."""
     from .obs import metrics as obs_metrics
     path = pathlib.Path(args.metrics_out)
     if path.suffix == ".prom":
         path.write_text(obs_metrics.to_prometheus())
+        if flight is not None:
+            path.with_name(path.stem + ".flight.json").write_text(
+                json.dumps({"version": obs_metrics.SCHEMA_VERSION,
+                            "metrics": {}, "flight": flight}, indent=2))
     else:
-        path.write_text(json.dumps(
-            {"version": obs_metrics.SCHEMA_VERSION,
-             "metrics": obs_metrics.snapshot()}, indent=2))
+        doc = {"version": obs_metrics.SCHEMA_VERSION,
+               "metrics": obs_metrics.snapshot()}
+        if flight is not None:
+            doc["flight"] = flight
+        path.write_text(json.dumps(doc, indent=2))
     if run_report is not None:
         rpath = path.with_name(path.stem + ".run_report.json")
         rpath.write_text(json.dumps(run_report, indent=2))
@@ -443,6 +468,31 @@ def _print_verbose(result) -> None:
     if tel is not None:
         totals = " ".join(f"{k}={v}" for k, v in tel["totals"].items())
         print(f"telemetry: {totals}", file=sys.stderr)
+    fl = result.extras.get("flight")
+    if fl is not None:
+        print(f"flight: {fl['n_windows']} windows x "
+              f"{fl['window_rounds']} rounds recorded — inspect with "
+              f"`python -m tools.teleview --metrics <metrics-out>`",
+              file=sys.stderr)
+
+
+def _progress_printer():
+    """The -v live progress line (one per chunk, stderr). Rate comes
+    from the flight recorder's live window when on, else from the
+    chunk's telemetry delta (None until the second chunk), else it is
+    omitted (plain runs still get round/ETA)."""
+    def emit(info: dict) -> None:
+        parts = [f"progress: r={info['round']}/{info['n_rounds']} "
+                 f"({100 * info['round'] // info['n_rounds']}%)"]
+        if info.get("window") is not None:
+            wi, nw = info["window"]
+            parts.append(f"window {wi + 1}/{nw}")
+        rate = info.get("commit_rate")
+        if rate is not None:
+            parts.append(f"commit_rate={rate:.1f}/round")
+        parts.append(f"eta={info['eta_s']:.1f}s")
+        print(" ".join(parts), file=sys.stderr, flush=True)
+    return emit
 
 
 def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
@@ -462,6 +512,10 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
         run_kw["telemetry"] = True
     if args.oracle_delivery != "auto":
         run_kw["oracle_delivery"] = args.oracle_delivery
+    if args.verbose and cfg.engine == "tpu" and not supervise:
+        # The live per-chunk line (supervised runs keep their own
+        # per-attempt reporting; the gauges update regardless).
+        run_kw["progress"] = _progress_printer()
 
     if supervise:
         from .network import supervisor
@@ -520,6 +574,40 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
         report["checkpoint_io"] = {
             k: round(v, 6) if isinstance(v, float) else v
             for k, v in io.items()}
+    fl = result.extras.get("flight")
+    if fl is not None:
+        from .obs import timeline as obs_timeline
+        tl = obs_timeline.from_flight_dict(fl)
+        derived = obs_timeline.derive(tl)
+        # Derived liveness gauges (timeline_*) land in the process
+        # registry BEFORE main's finally snapshots --metrics-out.
+        obs_timeline.export_metrics(derived)
+        # The full series goes into the metrics artifact (teleview's
+        # input) — only when one will be written: the .tolist()
+        # boxing is O(n_windows · K) Python objects, real heap at
+        # W=1 flagship scale. The one-line report carries the
+        # headline liveness numbers + the (small) latency
+        # histograms, schema-checked by validate_trace --cli-report.
+        if args.metrics_out:
+            report_holder["flight"] = {
+                "engine": fl["engine"],
+                "window_rounds": int(fl["window_rounds"]),
+                "n_windows": int(fl["n_windows"]),
+                "n_rounds": int(fl["n_rounds"]),
+                "bucket_lo": [int(b) for b in fl["bucket_lo"]],
+                "windows": {k: v.tolist()
+                            for k, v in fl["windows"].items()},
+                "latency": {k: v.tolist()
+                            for k, v in fl["latency"].items()},
+            }
+        report["flight"] = {
+            "window_rounds": int(fl["window_rounds"]),
+            "n_windows": int(fl["n_windows"]),
+            "availability": derived["availability"]["mean"],
+            "stall_windows": derived["stall_windows"]["total"],
+            "latency": {k: [int(x) for x in v.sum(axis=0)]
+                        for k, v in fl["latency"].items()},
+        }
     rr = result.extras.get("run_report")
     if rr is not None:
         report_holder["run_report"] = rr

@@ -90,6 +90,16 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     # faults the TPU worker (benchmarks/run_benchmarks.py).
     sweep_chunk: int = 0
 
+    # Flight recorder window width in rounds (docs/OBSERVABILITY.md
+    # §"Flight recorder"; TPU engine only, needs telemetry). 0 ⇒ off:
+    # the compiled round program is bit-for-bit the recorder-free one
+    # (tests/test_flight.py + the recorder-off hlocheck fingerprints).
+    # W > 0 additionally reduces the per-round telemetry counters into
+    # a [ceil(n_rounds/W), K] per-sweep window series and accumulates
+    # the per-engine protocol latency histograms, both riding the scan
+    # carry and checkpointed with it.
+    telemetry_window: int = 0
+
     def __post_init__(self) -> None:
         if self.protocol not in PROTOCOLS:
             raise ValueError(f"unknown protocol {self.protocol!r}")
@@ -135,6 +145,14 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
                              "is a subset of the population, SPEC §3b)")
         if self.sweep_chunk < 0:
             raise ValueError("sweep_chunk must be >= 0 (0 = one program)")
+        if self.telemetry_window < 0:
+            raise ValueError("telemetry_window must be >= 0 (0 = flight "
+                             "recorder off)")
+        if self.telemetry_window > 0 and self.engine == "cpu":
+            raise ValueError(
+                "telemetry_window > 0 is a tpu-engine feature (the flight "
+                "recorder rides the scan carry); the C++ oracle has no "
+                "telemetry to window and would silently ignore it")
         if self.protocol == "dpos":
             # Candidates are a subset of the validator population and
             # producers a subset of candidates — the C++ oracle rejects

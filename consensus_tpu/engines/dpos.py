@@ -96,9 +96,18 @@ DPOS_TELEMETRY = ("blocks_appended",     # validator-chain extensions
                   "churn_slots",         # rounds churned (no block)
                   ) + CRASH_TELEMETRY    # SPEC §6c (zeros when disabled)
 
+# Flight-recorder latency histogram (docs/OBSERVABILITY.md §"Flight
+# recorder"): chain_lag_rounds — one observation per round, the spread
+# max(chain_len) - min(chain_len) across validators. Blocks arrive at
+# most one per round, so the spread is how many ROUNDS the most-behind
+# validator trails the head — the catch-up/irreversibility lag the
+# SPEC §7 LIB rule is about, measurable on device without the host-side
+# per-producer run analysis lib_index does.
+DPOS_LATENCY = ("chain_lag_rounds",)
+
 
 def dpos_round(cfg: Config, producers, st: DposState, r, *,
-               telem: bool = False):
+               telem: bool = False, flight: bool = False):
     V, L = cfg.n_nodes, cfg.log_capacity
     seed = st.seed
     e = r // cfg.epoch_len
@@ -142,7 +151,12 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
     vec = jnp.stack([n_app, jnp.int32(V) - n_app,
                      ((r > 0) & (p != p_prev)).astype(jnp.int32),
                      churn.astype(jnp.int32), *cz])
-    return new, vec
+    if not flight:
+        return new, vec
+    from ..ops.flight import bucket_counts
+    lat = jnp.stack([bucket_counts(jnp.max(chain_len) - jnp.min(chain_len),
+                                   True)])
+    return new, vec, lat
 
 
 def dpos_make_carry(cfg: Config, seed):
@@ -172,6 +186,13 @@ def dpos_round_carry_telem(cfg: Config, carry, r):
     return (producers, new), vec
 
 
+def dpos_round_carry_flight(cfg: Config, carry, r):
+    producers, st = carry
+    new, vec, lat = dpos_round(cfg, producers, st, r, telem=True,
+                               flight=True)
+    return (producers, new), vec, lat
+
+
 def _dpos_extract(carry) -> dict:
     _, st = carry
     return {"chain_r": st.chain_r.astype(jnp.int32),
@@ -198,7 +219,9 @@ def get_engine():
         _ENGINE = EngineDef("dpos", dpos_make_carry, dpos_round_carry,
                             _dpos_extract, _dpos_pspec,
                             telemetry_names=DPOS_TELEMETRY,
-                            round_telem=dpos_round_carry_telem)
+                            round_telem=dpos_round_carry_telem,
+                            latency_names=DPOS_LATENCY,
+                            round_flight=dpos_round_carry_flight)
     return _ENGINE
 
 

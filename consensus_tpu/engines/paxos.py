@@ -80,8 +80,16 @@ PAXOS_TELEMETRY = ("promises",           # promise responses delivered
                    "values_learned",     # (node, slot) newly learned
                    ) + CRASH_TELEMETRY   # SPEC §6c (zeros when disabled)
 
+# Flight-recorder latency histogram (docs/OBSERVABILITY.md §"Flight
+# recorder"): rounds_to_learn — at each newly learned (node, slot),
+# the observation r + 1: every slot is contendable from round 0
+# (proposers pick slots uniformly per round), so r + 1 is exactly the
+# ballot rounds elapsed before this learner held the slot's value.
+PAXOS_LATENCY = ("rounds_to_learn",)
 
-def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False):
+
+def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False,
+                flight: bool = False):
     N, S = cfg.n_nodes, cfg.log_capacity
     P = cfg.n_proposers or N
     majority = N // 2 + 1
@@ -209,11 +217,20 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False):
     nack = is_prop[None, :] & prep_del & resp_del & ~prom
     vec = jnp.stack([cnt(prom), cnt(nack), cnt(accd), cnt(decided),
                      cnt(learn_now), *cz])
-    return new, vec
+    if not flight:
+        return new, vec
+    from ..ops.flight import bucket_counts
+    lat = jnp.stack([bucket_counts(jnp.asarray(r, jnp.int32) + 1,
+                                   learn_now)])
+    return new, vec, lat
 
 
 def paxos_round_telem(cfg: Config, st: PaxosState, r):
     return paxos_round(cfg, st, r, telem=True)
+
+
+def paxos_round_flight(cfg: Config, st: PaxosState, r):
+    return paxos_round(cfg, st, r, telem=True, flight=True)
 
 
 def _paxos_extract(st: PaxosState) -> dict:
@@ -239,7 +256,9 @@ def get_engine():
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("paxos", paxos_init, paxos_round, _paxos_extract,
                             _paxos_pspec, telemetry_names=PAXOS_TELEMETRY,
-                            round_telem=paxos_round_telem)
+                            round_telem=paxos_round_telem,
+                            latency_names=PAXOS_LATENCY,
+                            round_flight=paxos_round_flight)
     return _ENGINE
 
 

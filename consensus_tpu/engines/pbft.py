@@ -127,8 +127,21 @@ PBFT_TELEMETRY = ("prepare_quorums",   # (node, slot) newly prepared
                   "view_changes",      # Σ per-node view advance
                   ) + CRASH_TELEMETRY  # SPEC §6c (zeros when disabled)
 
+# Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
+# recorder"; shared with the §6b bcast kernel):
+#   view_change_wait_rounds — at each per-node view advance (timeout,
+#     churn, or f+1 catch-up), the node's pre-round timer + 1: rounds
+#     without progress before the view moved.
+#   slot_commit_rounds — at each newly committed (node, slot), the
+#     proposal-to-commit latency proxy r - s: primaries fill fresh
+#     slots in ascending order at most one per round (P3), so slot s
+#     cannot be pre-prepared before round s and r - s bounds its
+#     time-to-commit from below exactly under a stable primary.
+PBFT_LATENCY = ("view_change_wait_rounds", "slot_commit_rounds")
 
-def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
+
+def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
+               flight: bool = False):
     N, S = cfg.n_nodes, cfg.log_capacity
     f = cfg.f
     Q = 2 * f + 1
@@ -290,11 +303,22 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
                      jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
-    return new, vec
+    if not flight:
+        return new, vec
+    from ..ops.flight import bucket_counts
+    lat = jnp.stack([
+        bucket_counts(st.timer + 1, view > st.view),
+        bucket_counts(jnp.asarray(r, jnp.int32) - sarange[None, :],
+                      commit_now | adopt)])
+    return new, vec, lat
 
 
 def pbft_round_telem(cfg: Config, st: PbftState, r):
     return pbft_round(cfg, st, r, telem=True)
+
+
+def pbft_round_flight(cfg: Config, st: PbftState, r):
+    return pbft_round(cfg, st, r, telem=True, flight=True)
 
 
 def _pbft_extract(st: PbftState) -> dict:
@@ -319,7 +343,9 @@ def get_engine():
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("pbft", pbft_init, pbft_round, _pbft_extract,
                             _pbft_pspec, telemetry_names=PBFT_TELEMETRY,
-                            round_telem=pbft_round_telem)
+                            round_telem=pbft_round_telem,
+                            latency_names=PBFT_LATENCY,
+                            round_flight=pbft_round_flight)
     return _ENGINE
 
 

@@ -55,7 +55,8 @@ from ..ops.adversary import crash_counts, crash_transition, freeze_down
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import bitcast_i32 as _i32
-from .pbft import PBFT_TELEMETRY, PbftState, pbft_init
+from ..ops.flight import bucket_counts
+from .pbft import PBFT_LATENCY, PBFT_TELEMETRY, PbftState, pbft_init
 
 I32_MAX = jnp.iinfo(jnp.int32).max
 I32_MIN = jnp.iinfo(jnp.int32).min
@@ -359,7 +360,8 @@ def _aggregate_tallies(pp_val, pp_seen, prepared, committed, honest, bcast,
     return prep_hit, prepared2, commit_now, c5
 
 
-def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
+def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
+                     flight: bool = False):
     N, S = cfg.n_nodes, cfg.log_capacity
     f = cfg.f
     Q = 2 * f + 1
@@ -586,11 +588,23 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False):
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
                      jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
-    return new, vec
+    if not flight:
+        return new, vec
+    # Same PBFT_LATENCY semantics as the dense §6 kernel (the fault
+    # granularity changes, the measured quantities do not).
+    lat = jnp.stack([
+        bucket_counts(st.timer + 1, view > st.view),
+        bucket_counts(jnp.asarray(r, jnp.int32) - sarange[None, :],
+                      commit_now | adopt)])
+    return new, vec, lat
 
 
 def pbft_bcast_round_telem(cfg: Config, st: PbftState, r):
     return pbft_bcast_round(cfg, st, r, telem=True)
+
+
+def pbft_bcast_round_flight(cfg: Config, st: PbftState, r):
+    return pbft_bcast_round(cfg, st, r, telem=True, flight=True)
 
 
 def _extract(st: PbftState) -> dict:
@@ -616,5 +630,7 @@ def get_engine():
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("pbft-bcast", pbft_init, pbft_bcast_round,
                             _extract, _pspec, telemetry_names=PBFT_TELEMETRY,
-                            round_telem=pbft_bcast_round_telem)
+                            round_telem=pbft_bcast_round_telem,
+                            latency_names=PBFT_LATENCY,
+                            round_flight=pbft_bcast_round_flight)
     return _ENGINE

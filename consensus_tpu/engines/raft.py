@@ -191,14 +191,31 @@ RAFT_TELEMETRY = ("leader_elections",    # candidates winning this round
                   "entries_committed",   # Σ per-node commit-index advance
                   ) + CRASH_TELEMETRY    # SPEC §6c (zeros when disabled)
 
+# Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
+# recorder"): per-round duration observations bucketed on device by
+# ops/flight.bucket_counts, declared next to the counter names so the
+# validate_trace registry can be lint-synced the same way. Shared with
+# the §3b sparse kernel (same protocol, same semantics):
+#   election_wait_rounds — at each leader win, the winner's pre-round
+#     liveness timer + 1: rounds since it last heard from a leader (or
+#     reset) before gaining leadership — the leadership-gap latency.
+#   commit_lag_rounds — per round, each live leader's log_len - commit:
+#     proposed-but-uncommitted depth. Leaders propose at most one entry
+#     per round (P3a), so the depth IS the commit latency in rounds
+#     under a stable leader.
+RAFT_LATENCY = ("election_wait_rounds", "commit_lag_rounds")
 
-def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False):
+
+def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
+               flight: bool = False):
     """One SPEC §3 round. `cfg` static; `r` traced i32 scalar.
 
     ``telem=True`` additionally returns the :data:`RAFT_TELEMETRY`
     vector; the state computation is the identical trace either way
     (the counters read intermediates, XLA dead-code-eliminates them
-    when unused)."""
+    when unused). ``flight=True`` (implies telem) further returns the
+    :data:`RAFT_LATENCY` bucket matrix ``i32[H, N_BUCKETS]`` — same
+    digest-neutrality argument."""
     N, L = cfg.n_nodes, cfg.log_capacity
     E = min(cfg.max_entries, L)
     majority = N // 2 + 1
@@ -447,13 +464,24 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False):
                      jnp.sum(apply_.astype(jnp.int32)),
                      jnp.sum(append_rej.astype(jnp.int32)),
                      jnp.sum(commit - st.commit), *cz])
-    return new, vec
+    if not flight:
+        return new, vec
+    from ..ops.flight import bucket_counts
+    lat = jnp.stack([bucket_counts(st.timer + 1, win),
+                     bucket_counts(log_len - commit,
+                                   (role == ROLE_L) & ~down)])
+    return new, vec, lat
 
 
 def raft_round_telem(cfg: Config, st: RaftState, r):
     """EngineDef.round_telem entry — a stable named function (a
     functools.partial would hash by identity and fragment jit caches)."""
     return raft_round(cfg, st, r, telem=True)
+
+
+def raft_round_flight(cfg: Config, st: RaftState, r):
+    """EngineDef.round_flight entry (counters + latency buckets)."""
+    return raft_round(cfg, st, r, telem=True, flight=True)
 
 
 def _raft_extract(st: RaftState) -> dict:
@@ -479,7 +507,9 @@ def get_engine():
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("raft", raft_init, raft_round, _raft_extract,
                             _raft_pspec, telemetry_names=RAFT_TELEMETRY,
-                            round_telem=raft_round_telem)
+                            round_telem=raft_round_telem,
+                            latency_names=RAFT_LATENCY,
+                            round_flight=raft_round_flight)
     return _ENGINE
 
 

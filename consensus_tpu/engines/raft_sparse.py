@@ -43,8 +43,10 @@ from ..ops.adversary import delivery_edges as _edges
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import freeze_down as _freeze
-from .raft import (NONE, RAFT_TELEMETRY, ROLE_C, ROLE_F, ROLE_L,
-                   _draw_timeout, _last_term, _match_dtype, _pick1, _pick_row)
+from ..ops.flight import bucket_counts
+from .raft import (NONE, RAFT_LATENCY, RAFT_TELEMETRY, ROLE_C, ROLE_F,
+                   ROLE_L, _draw_timeout, _last_term, _match_dtype, _pick1,
+                   _pick_row)
 
 
 def _rows_from_small(small, rsel):
@@ -150,13 +152,15 @@ def _top_active(mask, term, idx, A: int):
 
 
 def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
-                      telem: bool = False):
+                      telem: bool = False, flight: bool = False):
     """One SPEC §3 round under the §3b active-sender cap. Mirrors the dense
     kernel phase by phase; every dense [N, N] object becomes [A, N]/[N, A].
     ``telem=True`` additionally returns the shared :data:`RAFT_TELEMETRY`
     counter vector (same semantics as the dense kernel's — elections are
     counted over the tracked candidate set, which under the §3b cap is
-    the only set that can win)."""
+    the only set that can win); ``flight=True`` adds the shared
+    :data:`RAFT_LATENCY` bucket matrix (winner waits read off the
+    tracked candidate slots)."""
     N, L, A = cfg.n_nodes, cfg.log_capacity, cfg.max_active
     E = min(cfg.max_entries, L)
     majority = N // 2 + 1
@@ -439,11 +443,20 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
                      jnp.sum(apply_.astype(jnp.int32)),
                      jnp.sum(append_rej.astype(jnp.int32)),
                      jnp.sum(commit - st.commit), *cz])
-    return new, vec
+    if not flight:
+        return new, vec
+    lat = jnp.stack([bucket_counts(st.timer[cid] + 1, win),
+                     bucket_counts(log_len - commit,
+                                   (role == ROLE_L) & ~down)])
+    return new, vec, lat
 
 
 def raft_sparse_round_telem(cfg: Config, st: RaftSparseState, r):
     return raft_sparse_round(cfg, st, r, telem=True)
+
+
+def raft_sparse_round_flight(cfg: Config, st: RaftSparseState, r):
+    return raft_sparse_round(cfg, st, r, telem=True, flight=True)
 
 
 def _extract(st: RaftSparseState) -> dict:
@@ -470,5 +483,7 @@ def get_engine():
         from ..network.runner import EngineDef
         _ENGINE = EngineDef("raft-sparse", raft_sparse_init, raft_sparse_round,
                             _extract, _pspec, telemetry_names=RAFT_TELEMETRY,
-                            round_telem=raft_sparse_round_telem)
+                            round_telem=raft_sparse_round_telem,
+                            latency_names=RAFT_LATENCY,
+                            round_flight=raft_sparse_round_flight)
     return _ENGINE

@@ -41,7 +41,9 @@ import numpy as np
 
 from ..core.config import Config
 from ..obs import metrics as obs_metrics
+from ..obs import timeline as obs_timeline
 from ..obs import trace as obs_trace
+from ..ops import flight as flightlib
 from ..parallel import mesh as meshlib
 from . import ckpt_writer, faults
 
@@ -62,6 +64,13 @@ class EngineDef:
     The vector is accumulated across the scan alongside the carry and
     never feeds back into state, so enabling it is digest-neutral by
     construction (tests/test_obs.py proves bit-identity per engine).
+
+    Optional flight recorder (docs/OBSERVABILITY.md §"Flight recorder"):
+    round_flight(cfg, carry, r) -> (carry, i32[K], i32[H, N_BUCKETS])
+    extends round_telem with the engine's per-round protocol-latency
+    bucket matrix (H = len(latency_names), buckets per
+    ops/flight.bucket_counts). Selected by cfg.telemetry_window > 0;
+    same digest-neutrality contract (tests/test_flight.py).
     """
     name: str
     make_carry: Callable[..., Any]
@@ -70,6 +79,28 @@ class EngineDef:
     carry_pspec: Callable[[Config], Any]
     telemetry_names: tuple[str, ...] = ()
     round_telem: Callable[..., Any] | None = None
+    latency_names: tuple[str, ...] = ()
+    round_flight: Callable[..., Any] | None = None
+
+
+def n_windows(cfg: Config) -> int:
+    """Static window count of the flight-recorder ring:
+    ceil(n_rounds / telemetry_window). Requires telemetry_window > 0."""
+    return -(-cfg.n_rounds // cfg.telemetry_window)
+
+
+def flight_structs(cfg: Config, eng: EngineDef) -> tuple:
+    """ShapeDtypeStructs of the flight recorder's (win, lat) arrays for
+    ``cfg`` (``telemetry_window`` must be > 0) — the ONE declaration of
+    the recorder geometry, shared by :func:`run` (checkpoint template +
+    initial zeros) and ``tools/hlocheck``'s recorder-ON lowering, so the
+    fingerprinted program cannot drift from the dispatched one."""
+    return (jax.ShapeDtypeStruct(
+                (cfg.n_sweeps, n_windows(cfg), len(eng.telemetry_names)),
+                jnp.int32),
+            jax.ShapeDtypeStruct(
+                (cfg.n_sweeps, len(eng.latency_names), flightlib.N_BUCKETS),
+                jnp.int32))
 
 
 def make_seeds(cfg: Config) -> np.ndarray:
@@ -84,23 +115,85 @@ def _init_jit(cfg: Config, eng: EngineDef, seeds, *, mesh=None):
     return meshlib.constrain(carry, cfg, mesh, eng.carry_pspec(cfg))
 
 
+def _chunk_body(cfg: Config, eng: EngineDef, mesh, pspec, masked: bool,
+                telemetry: bool, recorder: bool):
+    """Build the shared scan body every chunked dispatch runs — the one
+    place the telemetry accumulator and the flight-recorder window ring
+    + latency histograms attach to the round loop, for all six engines.
+
+    The scan carry is ``(c, t, w, h)``: engine carry, [B, K] running
+    counter totals, [B, n_windows, K] window ring, [B, H, N_BUCKETS]
+    latency buckets. ``t``/``w``/``h`` are None (empty pytree nodes —
+    zero leaves, nothing traced) below their enabling flag, so the
+    telemetry-off and recorder-off programs are byte-for-byte the
+    narrower ones (pinned by the recorder-off hlocheck fingerprints).
+
+    The window add is a dynamic-slice + add + dynamic-update-slice at
+    window index ``r // telemetry_window`` — O(B·K) per round, never a
+    scatter (serial unit) and never an [n_windows]-one-hot.
+    """
+    W = cfg.telemetry_window
+
+    def shard_sweep(x):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(
+                    meshlib.SWEEP_AXIS, *([None] * (x.ndim - 1)))))
+
+    def body(ct, ra):
+        c, t, w, h = ct
+        if masked:
+            r, active = ra
+        else:
+            r = ra
+        if recorder:
+            new, d, lh = jax.vmap(
+                lambda s: eng.round_flight(cfg, s, r))(c)
+            if masked:  # the dead lane must not double-count
+                d = jnp.where(active, d, jnp.zeros_like(d))
+                lh = jnp.where(active, lh, jnp.zeros_like(lh))
+            t = shard_sweep(t + d)
+            wi = r // jnp.int32(W)
+            z = jnp.int32(0)
+            cur = jax.lax.dynamic_slice(
+                w, (z, wi, z), (w.shape[0], 1, w.shape[2]))
+            w = shard_sweep(jax.lax.dynamic_update_slice(
+                w, cur + d[:, None, :], (z, wi, z)))
+            h = shard_sweep(h + lh)
+        elif telemetry:
+            new, d = jax.vmap(lambda s: eng.round_telem(cfg, s, r))(c)
+            if masked:  # the dead lane must not double-count
+                d = jnp.where(active, d, jnp.zeros_like(d))
+            t = shard_sweep(t + d)
+        else:
+            new = jax.vmap(lambda s: eng.round_fn(cfg, s, r))(c)
+        if masked:
+            new = jax.tree.map(lambda a, b: jnp.where(active, a, b), new, c)
+        return (meshlib.constrain(new, cfg, mesh, pspec), t, w, h), None
+
+    return body
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("mesh",),
-                   donate_argnums=(3, 5))
+                   donate_argnums=(3, 5, 6, 7))
 def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0,
-               telem=None, *, mesh=None):
+               telem=None, win=None, lat=None, *, mesh=None):
     """Advance the batched carry by ``n_rounds`` rounds starting at ``r0``.
 
-    The carry (and the telemetry accumulator, when present) is DONATED:
-    every input leaf has a same-shape/dtype output leaf, so XLA aliases
-    the buffers (``input_output_alias`` in the compiled module —
-    statically enforced by ``tools/hlocheck``'s donation contract) and a
-    chunked run holds ONE carry instead of two across dispatches — the
-    ROADMAP bandwidth lever at 100k-node carries. Consequences at the
-    call sites: the passed-in carry is dead after the call (callers must
-    rebind, which they all did already), and any reference that must
-    outlive the next dispatch — the async checkpoint writer's pending
-    snapshot — must be a copy (see :func:`_snapshot_copy`). Inside an
-    outer jit trace (``__graft_entry__.entry``) donation is inert.
+    The carry (and the telemetry accumulator + flight-recorder arrays,
+    when present) is DONATED: every input leaf has a same-shape/dtype
+    output leaf, so XLA aliases the buffers (``input_output_alias`` in
+    the compiled module — statically enforced by ``tools/hlocheck``'s
+    donation contract) and a chunked run holds ONE carry instead of two
+    across dispatches — the ROADMAP bandwidth lever at 100k-node
+    carries. Consequences at the call sites: the passed-in carry is dead
+    after the call (callers must rebind, which they all did already),
+    and any reference that must outlive the next dispatch — the async
+    checkpoint writer's pending snapshot — must be a copy (see
+    :func:`_snapshot_copy`). Inside an outer jit trace
+    (``__graft_entry__.entry``) donation is inert.
 
     The round body must stay inside a scan of length >= 2: XLA unrolls a
     length-1 scan into the top-level computation, and the CPU backend's
@@ -109,49 +202,40 @@ def _chunk_jit(cfg: Config, eng: EngineDef, n_rounds: int, carry, r0,
     A 1-round chunk therefore scans a masked pair: round r0, then a
     dead lane whose output is discarded leaf-wise.
 
-    ``telem`` (optional, [B, K] i32) switches the scan body to
-    ``eng.round_telem`` and rides the scan carry as a running per-sweep
-    counter accumulator; the return becomes ``(carry, telem)``. With
-    ``telem=None`` (default) the call and return shapes are unchanged —
-    the callers predating telemetry (tests, __graft_entry__) keep
-    working verbatim, and the no-telemetry program is byte-for-byte the
-    pre-telemetry one (nothing new is traced).
+    ``telem`` (optional, [B, K] i32) switches the scan body (built by
+    :func:`_chunk_body`) to ``eng.round_telem`` and rides the scan carry
+    as a running per-sweep counter accumulator; the return becomes
+    ``(carry, telem)``. ``win``/``lat`` (optional, [B, n_windows, K] /
+    [B, H, N_BUCKETS] i32 — passed together, with ``telem``) switch to
+    ``eng.round_flight`` and additionally accumulate the window ring and
+    latency histograms; the return becomes ``(carry, telem, win, lat)``.
+    With the defaults the call and return shapes are unchanged — callers
+    predating telemetry (tests, __graft_entry__) keep working verbatim,
+    and the no-telemetry / no-recorder programs are byte-for-byte the
+    pre-feature ones (nothing new is traced; None arguments carry zero
+    pytree leaves).
     """
     pspec = eng.carry_pspec(cfg)
     telemetry = telem is not None
+    recorder = win is not None
+    if recorder and (lat is None or not telemetry):
+        raise ValueError("the flight recorder rides the telemetry "
+                         "accumulator: pass telem, win AND lat together")
     # Only the padded 1-round chunk needs the dead-lane select; for real
     # chunks every scan step is live, and a full-carry jnp.where per round
     # costs measurable HBM traffic (bench.py ran ~25% under the bare
     # kernel before this was made conditional).
     masked = n_rounds == 1
-
-    def body(ct, ra):
-        c, t = ct
-        if masked:
-            r, active = ra
-        else:
-            r = ra
-        if telemetry:
-            new, d = jax.vmap(lambda s: eng.round_telem(cfg, s, r))(c)
-            if masked:  # the dead lane must not double-count
-                d = jnp.where(active, d, jnp.zeros_like(d))
-            t = t + d
-            if mesh is not None:
-                t = jax.lax.with_sharding_constraint(
-                    t, jax.sharding.NamedSharding(
-                        mesh, jax.sharding.PartitionSpec(
-                            meshlib.SWEEP_AXIS, None)))
-        else:
-            new = jax.vmap(lambda s: eng.round_fn(cfg, s, r))(c)
-        if masked:
-            new = jax.tree.map(lambda a, b: jnp.where(active, a, b), new, c)
-        return (meshlib.constrain(new, cfg, mesh, pspec), t), None
+    body = _chunk_body(cfg, eng, mesh, pspec, masked, telemetry, recorder)
 
     if masked:
         xs = (jnp.stack([r0, r0]), jnp.asarray([True, False]))
     else:
         xs = r0 + jnp.arange(n_rounds, dtype=jnp.int32)
-    (carry, telem), _ = jax.lax.scan(body, (carry, telem), xs)
+    (carry, telem, win, lat), _ = jax.lax.scan(
+        body, (carry, telem, win, lat), xs)
+    if recorder:
+        return carry, telem, win, lat
     return (carry, telem) if telemetry else carry
 
 
@@ -432,7 +516,25 @@ def _meta_matches(meta: dict, cfg: Config, seeds) -> bool:
     if not set(saved) <= {f.name for f in dataclasses.fields(Config)}:
         return False
     try:
-        if Config.from_json(json.dumps(saved)) != cfg:
+        # telemetry_window is an observability knob, not trajectory
+        # identity: the carry a recorder-off run saved IS the carry a
+        # recorder-on run would have (digest-neutral by construction).
+        # ACROSS the on/off boundary the fields compare normalized and
+        # the ring's presence is settled at the leaf-count level
+        # (load_checkpoint's schema skip), where a mismatch degrades
+        # loudly instead of silently rejecting every cross-setting
+        # snapshot. Between two recorder-ON runs, though, W is the
+        # series' bin geometry — the saved ring's windows mean rounds
+        # [i*W_saved, ...) — so differing nonzero values are a real
+        # mismatch: equal n_windows could otherwise resume a ring whose
+        # bins this run would extend at a different width.
+        saved_cfg = Config.from_json(json.dumps(saved))
+        if saved_cfg.telemetry_window == 0 or cfg.telemetry_window == 0:
+            saved_cfg = dataclasses.replace(saved_cfg, telemetry_window=0)
+            want_cfg = dataclasses.replace(cfg, telemetry_window=0)
+        else:
+            want_cfg = cfg
+        if saved_cfg != want_cfg:
             return False
     except (ValueError, TypeError):
         return False
@@ -461,9 +563,19 @@ def _scan_valid(path, cfg: Config, seeds):
 
 
 def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None, *,
-                    io: dict | None = None):
+                    io: dict | None = None, recorder_template=None):
     """Return (carry, next_round) from the newest VALID snapshot of
     ``path`` — or None when no rotation is both intact and matching.
+
+    ``recorder_template`` (a tuple of ShapeDtypeStructs for the flight
+    recorder's window ring + latency histograms) declares that the
+    caller snapshots ``(carry, win, lat)`` tuples instead of the bare
+    carry; the returned first element is then that tuple. A snapshot
+    whose leaf count disagrees — written with the recorder off and
+    loaded with it on, or vice versa — is skipped LOUDLY via the
+    schema-skip path below (the run restarts from round 0 with a
+    stderr message), never a pytree/shape crash
+    (tests/test_flight.py pins both directions).
 
     ``seeds`` is the seed vector the caller will resume under (default
     ``make_seeds(cfg)``); a snapshot taken under a different vector is a
@@ -488,6 +600,8 @@ def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None, *,
             template = jax.eval_shape(
                 lambda s: _init_template(cfg, eng, s),
                 jax.ShapeDtypeStruct((cfg.n_sweeps,), jnp.uint32))
+            if recorder_template is not None:
+                template = (template,) + tuple(recorder_template)
             # Cast to the template dtypes: an engine may narrow a state
             # field's storage dtype between versions (e.g. raft match/next
             # i32 -> u8); the saved integer values are identical, but
@@ -495,13 +609,36 @@ def load_checkpoint(path, cfg: Config, eng: EngineDef, seeds=None, *,
             # returns.
             tleaves = jax.tree.leaves(template)
             if len(leaves) != len(tleaves):
-                # A carry schema from another era (e.g. a state field
-                # added since the snapshot was written — SPEC §6c's
-                # `down` mask). The saved trajectory is still valid but
-                # its pytree can't be unflattened into today's carry:
-                # treat as not-my-snapshot and try the next rotation.
+                # A carry schema from another era: a state field added
+                # since the snapshot was written (SPEC §6c's `down`
+                # mask), or a flight-recorder on/off mismatch (the ring
+                # + histogram leaves ride the snapshot only when
+                # telemetry_window > 0). The saved trajectory is still
+                # valid but its pytree can't be unflattened into this
+                # run's carry: treat as not-my-snapshot and try the
+                # next rotation — a loud degradation, not a shape
+                # crash.
                 _log_ckpt(f"{cand}: carry has {len(leaves)} leaves, "
-                          f"engine expects {len(tleaves)} — skipping")
+                          f"this run expects {len(tleaves)} (carry "
+                          f"schema from another era — e.g. a flight-"
+                          f"recorder on/off mismatch) — skipping")
+                continue
+            shape_drift = [(np.asarray(leaf).shape, t.shape)
+                           for leaf, t in zip(leaves, tleaves)
+                           if np.asarray(leaf).shape != t.shape]
+            if shape_drift:
+                # Same leaf COUNT but a different leaf shape. W-vs-W
+                # recorder mismatches are already settled upstream
+                # (_meta_matches rejects differing nonzero
+                # telemetry_window); this is the defensive backstop
+                # for any OTHER same-arity geometry drift (an engine
+                # reshaping a state field between versions, a foreign
+                # snapshot). Unflattening would silently corrupt the
+                # carry, so skip loudly instead.
+                got, want = shape_drift[0]
+                _log_ckpt(f"{cand}: carry leaf shape {got} != expected "
+                          f"{want} (e.g. a flight-recorder window-"
+                          f"geometry mismatch) — skipping")
                 continue
             leaves = [np.asarray(leaf).astype(t.dtype)
                       for leaf, t in zip(leaves, tleaves)]
@@ -604,13 +741,49 @@ def _prepare(cfg: Config, eng: EngineDef, mesh, seeds=None):
     return mesh, seeds
 
 
+# Telemetry counters that measure COMMIT progress — derived from the
+# timeline layer's per-engine declaration so the live -v progress line
+# and the derived availability/stall metrics can never rate different
+# counters (obs/timeline is numpy-only at import; no cycle).
+PROGRESS_COUNTERS = frozenset(
+    name for names in obs_timeline.COMMIT_COUNTERS.values()
+    for name in names)
+
+
+def _progress_info(cfg: Config, eng: EngineDef, r: int, n: int, telem, win,
+                   prev_total: int) -> tuple[dict, int]:
+    """The live-progress datum after an ``n``-round chunk ended at round
+    ``r``: the current commit rate (per round, summed over sweeps) read
+    off the flight recorder's LIVE window when present, else the last
+    chunk's delta of the running telemetry totals. Pulls only O(B·K)
+    bytes, but the pull IS a device sync — this only runs under an
+    installed progress callback (-v)."""
+    idx = [k for k, name in enumerate(eng.telemetry_names)
+           if name in PROGRESS_COUNTERS]
+    info: dict = {"round": r, "n_rounds": cfg.n_rounds}
+    if win is not None:
+        W = cfg.telemetry_window
+        wi = (r - 1) // W
+        row = np.asarray(win[:, wi, :])          # [B, K] — the live window
+        in_window = (r - 1) % W + 1
+        info["window"] = (wi, int(win.shape[1]))
+        info["commit_rate"] = float(row[:, idx].sum()) / in_window
+        return info, prev_total
+    total = int(np.asarray(telem)[:, idx].sum()) if telem is not None else 0
+    info["commit_rate"] = None if prev_total < 0 or telem is None else \
+        (total - prev_total) / n
+    return info, total
+
+
 def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
              mesh, checkpoint_path=None, seeds=None, keep: int = 1,
              telem=None, io: dict | None = None, fsync: bool = False,
-             writer=None):
+             writer=None, win=None, lat=None, progress=None):
     """Drive fixed-shape jitted chunks from ``start`` to ``cfg.n_rounds``.
-    Returns ``(carry, telem)`` — ``telem`` is the accumulated [B, K]
-    telemetry counters, or None when telemetry is off.
+    Returns ``(carry, telem, win, lat)`` — ``telem`` is the accumulated
+    [B, K] telemetry counters, ``win``/``lat`` the flight recorder's
+    [B, n_windows, K] window ring and [B, H, N_BUCKETS] latency buckets
+    (None for whichever layer is off).
 
     With ``writer`` (a :class:`ckpt_writer.CheckpointWriter`) snapshots
     are ENQUEUED and written in the background while the next chunk
@@ -632,14 +805,33 @@ def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
     continue past it; any subsequent checkpoint pull (a device→host
     transfer) absorbs the remainder, which with the async writer now
     happens on the writer thread (the ``ckpt_snapshot`` span).
+
+    After every chunk the ``rounds_completed`` and ``sim_eta_s`` gauges
+    are updated (the sweep-service job-status datum — readable from a
+    ``--metrics-out`` snapshot of a still-running process); ``progress``
+    (a callable taking one info dict) additionally receives the live
+    commit rate per chunk — see :func:`_progress_info` for the device
+    sync it costs, which is why it only rides ``-v``.
+
+    With the flight recorder on (``win``/``lat`` arrays passed), mid-run
+    snapshots hold the ``(carry, win, lat)`` TUPLE — the window ring
+    resumes with the carry so a recovered run's series covers the whole
+    trajectory (tests/test_flight.py), while the plain telemetry totals
+    stay deliberately un-checkpointed (they cover executed rounds).
     """
     r = start
+    t_loop = time.perf_counter()
+    prev_total = -1
     while r < cfg.n_rounds:
         faults.on_dispatch()
         n = min(chunk, cfg.n_rounds - r)
         t0 = time.perf_counter()
         with obs_trace.span("dispatch", engine=eng.name, r0=r, n_rounds=n):
-            if telem is None:
+            if win is not None:
+                carry, telem, win, lat = _chunk_jit(
+                    cfg, eng, n, carry, jnp.int32(r), telem, win, lat,
+                    mesh=mesh)
+            elif telem is None:
                 carry = _chunk_jit(cfg, eng, n, carry, jnp.int32(r),
                                    mesh=mesh)
             else:
@@ -648,15 +840,25 @@ def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
         obs_metrics.histogram("dispatch_wall_s").observe(
             time.perf_counter() - t0)
         r += n
+        obs_metrics.gauge("rounds_completed").set(r)
+        elapsed = time.perf_counter() - t_loop
+        eta = elapsed / (r - start) * (cfg.n_rounds - r)
+        obs_metrics.gauge("sim_eta_s").set(round(eta, 3))
+        if progress is not None:
+            info, prev_total = _progress_info(cfg, eng, r, n, telem, win,
+                                              prev_total)
+            info["eta_s"] = eta
+            progress(info)
         if checkpoint_path and r < cfg.n_rounds:
+            snap = (carry, win, lat) if win is not None else carry
             if writer is not None:
                 # The writer's pull overlaps the NEXT dispatch, which
                 # donates (and so recycles) this carry's buffers — hand
                 # the writer its own copy (see _snapshot_copy).
-                writer.submit(checkpoint_path, cfg, _snapshot_copy(carry),
+                writer.submit(checkpoint_path, cfg, _snapshot_copy(snap),
                               r, seeds=seeds, keep=keep, fsync=fsync)
             else:
-                rec = save_checkpoint(checkpoint_path, cfg, carry, r,
+                rec = save_checkpoint(checkpoint_path, cfg, snap, r,
                                       seeds=seeds, keep=keep, fsync=fsync)
                 if io is not None:
                     io["saves"] += 1
@@ -674,7 +876,7 @@ def _advance(cfg: Config, eng: EngineDef, carry, start: int, chunk: int,
             if io is not None:
                 io["save_s"] += time.perf_counter() - t0
         faults.on_chunk_end()
-    return carry, telem
+    return carry, telem, win, lat
 
 
 def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
@@ -700,8 +902,8 @@ def run_device(cfg: Config, eng: EngineDef, *, mesh=None, seeds=None):
         return carry
     mesh, seeds = _prepare(cfg, eng, mesh, seeds)
     carry = _init_jit(cfg, eng, seeds, mesh=mesh)
-    carry, _ = _advance(cfg, eng, carry, 0, cfg.scan_chunk or cfg.n_rounds,
-                        mesh)
+    carry, _, _, _ = _advance(cfg, eng, carry, 0,
+                              cfg.scan_chunk or cfg.n_rounds, mesh)
     # Sync barrier, O(1) bytes: transfer a jitted 1-element slice of a
     # final-carry leaf. The slice program has a data dependency on the
     # whole round loop, so its 4-byte result reaching the host proves
@@ -801,7 +1003,7 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         seeds=None, keep_checkpoints: int = 2,
         telemetry: bool = False, fsync_checkpoints: bool = False,
         sync_checkpoints: bool = False,
-        group_dir=None) -> dict[str, np.ndarray]:
+        group_dir=None, progress=None) -> dict[str, np.ndarray]:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
@@ -845,6 +1047,24 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     cover the rounds THIS process executed — a resumed run restarts
     them at zero, mirroring ``executed_rounds``; they are deliberately
     not checkpointed (the snapshot format stays telemetry-agnostic).
+
+    ``cfg.telemetry_window > 0`` (the FLIGHT RECORDER,
+    docs/OBSERVABILITY.md §"Flight recorder"; requires ``telemetry``)
+    additionally reduces the same counters into a bounded
+    ``[n_sweeps, n_windows, K]`` window ring plus the engine's
+    ``[n_sweeps, H, N_BUCKETS]`` protocol-latency histograms, riding the
+    scan carry, and fills ``stats["flight"]``. Unlike the totals, the
+    ring and histograms ARE checkpointed (the snapshot becomes the
+    ``(carry, win, lat)`` tuple), so a resumed run's series covers the
+    whole trajectory — SIGKILL-resume yields the identical series
+    (tests/test_flight.py). Same digest-neutrality contract; with the
+    field at 0 the compiled program is byte-for-byte the recorder-free
+    one (the recorder-off hlocheck fingerprints).
+
+    ``progress`` (a callable receiving one info dict per chunk) gets
+    the live commit rate + ETA the CLI prints at ``-v``; the
+    ``rounds_completed``/``sim_eta_s`` gauges update per chunk
+    regardless (see :func:`_advance`).
     """
     if telemetry and eng.round_telem is None:
         raise ValueError(f"engine {eng.name!r} provides no telemetry "
@@ -852,6 +1072,16 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     if telemetry and stats is None:
         raise ValueError("telemetry=True needs a stats dict to receive "
                          "the counters (stats['telemetry'])")
+    recorder = cfg.telemetry_window > 0
+    if recorder and not telemetry:
+        raise ValueError(
+            "telemetry_window > 0 without telemetry=True: the window "
+            "ring IS the telemetry counter series, windowed — enable "
+            "telemetry (the CLI's --telemetry-window implies it) rather "
+            "than silently recording nothing")
+    if recorder and eng.round_flight is None:
+        raise ValueError(f"engine {eng.name!r} provides no flight "
+                         "recorder (EngineDef.round_flight is None)")
     if fsync_checkpoints and not (checkpoint_path or group_dir):
         raise ValueError("fsync_checkpoints=True without a checkpoint_path "
                          "would be silently ignored (nothing is saved)")
@@ -891,7 +1121,7 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
                              "snapshots, sweep_chunk=0, or group_dir= for "
                              "the per-group snapshot layout")
         all_seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
-        outs, telems, done = [], [], []
+        outs, telems, flights, done = [], [], [], []
         gio = _empty_io() if group_dir else None
         for gi, (sub, s) in enumerate(groups):
             gstats: dict = {}
@@ -903,7 +1133,7 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
                           fsync_checkpoints=fsync_checkpoints,
                           sync_checkpoints=sync_checkpoints)
             outs.append(run(sub, eng, mesh=mesh, stats=gstats, seeds=s,
-                            telemetry=telemetry, **kw))
+                            telemetry=telemetry, progress=progress, **kw))
             if group_dir:
                 done.append(gi)
                 write_group_manifest(group_dir, cfg, all_seeds, done,
@@ -912,6 +1142,8 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
                     gio[k] += v
             if telemetry:
                 telems.append(gstats.pop("telemetry"))
+            if recorder:
+                flights.append(gstats.pop("flight"))
             if stats is not None:
                 stats.update(gstats)
         if group_dir and stats is not None:
@@ -920,21 +1152,46 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
             stats["telemetry"] = {
                 k: np.concatenate([t[k] for t in telems])
                 for k in telems[0]}
+        if recorder:
+            # Groups split the SWEEP axis; windows/latency concatenate
+            # along it like the telemetry vectors (the series are
+            # per-sweep).
+            stats["flight"] = {
+                **{k: flights[0][k]
+                   for k in ("window_rounds", "n_windows", "n_rounds",
+                             "bucket_lo")},
+                "windows": {k: np.concatenate([f["windows"][k]
+                                               for f in flights])
+                            for k in flights[0]["windows"]},
+                "latency": {k: np.concatenate([f["latency"][k]
+                                               for f in flights])
+                            for k in flights[0]["latency"]},
+            }
         return {k: np.concatenate([o[k] for o in outs], axis=0)
                 for k in outs[0]}
     mesh, seeds = _prepare(cfg, eng, mesh, seeds)
 
     io = _empty_io() if checkpoint_path else None
+    win = lat = None
+    recorder_template = flight_structs(cfg, eng) if recorder else None
     start = 0
     carry = None
     if resume and checkpoint_path:
         loaded = load_checkpoint(checkpoint_path, cfg, eng, seeds=seeds,
-                                 io=io)
+                                 io=io, recorder_template=recorder_template)
         if loaded is not None:
             carry, start = loaded
+            if recorder:
+                # The ring + histograms resume with the carry: the
+                # recovered series covers the WHOLE trajectory.
+                carry, win, lat = carry
+                win, lat = jax.device_put(win), jax.device_put(lat)
             carry = jax.device_put(carry)
     if carry is None:
         carry = _init_jit(cfg, eng, seeds, mesh=mesh)
+    if recorder and win is None:
+        win = jnp.zeros(recorder_template[0].shape, jnp.int32)
+        lat = jnp.zeros(recorder_template[1].shape, jnp.int32)
 
     # A checkpoint request implies chunking — a single-chunk run would
     # finish (or die) without ever writing a snapshot, so derive a chunk
@@ -959,10 +1216,12 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     writer = (ckpt_writer.CheckpointWriter(io=io)
               if checkpoint_path and not sync_checkpoints else None)
     try:
-        carry, telem = _advance(cfg, eng, carry, start, chunk, mesh,
-                                checkpoint_path, seeds=np.asarray(seeds),
-                                keep=keep_checkpoints, telem=telem, io=io,
-                                fsync=fsync_checkpoints, writer=writer)
+        carry, telem, win, lat = _advance(
+            cfg, eng, carry, start, chunk, mesh,
+            checkpoint_path, seeds=np.asarray(seeds),
+            keep=keep_checkpoints, telem=telem, io=io,
+            fsync=fsync_checkpoints, writer=writer, win=win, lat=lat,
+            progress=progress)
     except BaseException:
         if writer is not None:
             # Wait for the in-flight write (a supervisor retry's resume
@@ -991,5 +1250,18 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
             stats["telemetry"] = {
                 name: tarr[:, k]
                 for k, name in enumerate(eng.telemetry_names)}
+        if recorder:
+            warr = np.asarray(win).astype(np.int64)
+            larr = np.asarray(lat).astype(np.int64)
+            stats["flight"] = {
+                "window_rounds": cfg.telemetry_window,
+                "n_windows": n_windows(cfg),
+                "n_rounds": cfg.n_rounds,
+                "bucket_lo": list(flightlib.BUCKET_LO),
+                "windows": {name: warr[:, :, k]
+                            for k, name in enumerate(eng.telemetry_names)},
+                "latency": {name: larr[:, h, :]
+                            for h, name in enumerate(eng.latency_names)},
+            }
 
     return {k: np.asarray(v) for k, v in eng.extract(carry).items()}

@@ -100,7 +100,11 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
             from ..obs import trace as obs_trace
             with obs_trace.span("warmup", protocol=cfg.protocol):
                 with obs_trace.suspended(), obs_metrics.paused():
-                    _run_jax(cfg, **kw)
+                    # No live-progress lines for the hidden compile pass
+                    # (its "rounds" would double every count the user
+                    # sees) — the gauges are paused with the metrics.
+                    _run_jax(cfg, **{k: v for k, v in kw.items()
+                                     if k != "progress"})
         t0 = time.perf_counter()
         out = _run_jax(cfg, **kw)
         wall = time.perf_counter() - t0
@@ -135,6 +139,12 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
                 "per_sweep": {k: np.asarray(v) for k, v in tstats.items()},
                 "totals": {k: int(np.asarray(v, dtype=np.int64).sum())
                            for k, v in tstats.items()}}
+        fl = stats.get("flight")
+        if fl is not None:
+            # The flight recorder's windowed series + latency histograms
+            # (docs/OBSERVABILITY.md §"Flight recorder") — the engine
+            # name keys the timeline layer's commit-counter choice.
+            extras["flight"] = {"engine": engine_def(cfg).name, **fl}
         io = stats.get("checkpoint_io")
         if io is not None:
             # Save/load wall time + npz bytes, recorded even with

@@ -151,8 +151,11 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     ``telemetry=True`` enables the tpu engine's on-device protocol
     counters (``RunResult.extras["telemetry"]``, docs/OBSERVABILITY.md).
     A CPU-oracle fallback run carries no on-device telemetry — the
-    degraded result's extras simply lack the key, and
-    ``report.fallback_used`` says why.
+    degraded result's extras simply lack the key (likewise the flight
+    recorder's ``"flight"`` series when ``cfg.telemetry_window > 0``:
+    the fallback drops the digest-neutral recorder rather than dying on
+    the oracle's rejection of it), and ``report.fallback_used`` says
+    why.
 
     Supervision itself is observable: each attempt runs inside a
     ``supervised_attempt`` trace span, retries/backoffs emit events and
@@ -263,11 +266,15 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
         # Degrade to the scalar oracle: same Config schema, same decided
         # logs byte-for-byte (the framework's acceptance criterion), so
         # the caller still gets a correct result — just slowly. A fresh
-        # run: the oracle has no checkpoint/resume surface.
+        # run: the oracle has no checkpoint/resume surface. The flight
+        # recorder degrades WITH the telemetry it windows (the oracle
+        # has neither; Config would reject telemetry_window > 0 on the
+        # cpu engine) — digest-neutral, so the payload contract holds.
         report.fallback_used = True
         obs_metrics.counter("supervisor_fallbacks_total").inc()
         with obs_trace.span("oracle_fallback", protocol=cfg.protocol):
-            result = simulator.run(dataclasses.replace(cfg, engine="cpu"),
+            result = simulator.run(dataclasses.replace(cfg, engine="cpu",
+                                                       telemetry_window=0),
                                    warmup=False)
         result.extras["run_report"] = report.to_dict()
         return result

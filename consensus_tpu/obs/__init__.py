@@ -20,6 +20,6 @@ Nothing here imports jax at module import time — the trace module
 touches ``jax.profiler`` lazily and only when profiler annotation was
 explicitly requested.
 """
-from . import metrics, trace  # noqa: F401
+from . import metrics, timeline, trace  # noqa: F401
 
-__all__ = ["metrics", "trace"]
+__all__ = ["metrics", "timeline", "trace"]

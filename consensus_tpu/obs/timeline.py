@@ -1,0 +1,298 @@
+"""Timeline analysis of flight-recorder series (docs/OBSERVABILITY.md
+§"Flight recorder").
+
+The device side reduces per-round telemetry into a bounded
+``[n_sweeps, n_windows, K]`` window ring plus per-engine protocol
+latency histograms; this module is the HOST side — it loads those series
+from a ``--metrics-out`` snapshot (or a recorder-on checkpoint), derives
+the liveness metrics the adversary scenarios are judged by, and renders
+text/JSON summaries (``python -m tools.teleview``):
+
+  * **commit throughput per window** — the engine's commit-progress
+    counters (:data:`COMMIT_COUNTERS`) per round, per window;
+  * **stall windows** — windows with ZERO commit progress (the
+    "does LIB stall" / "commit stall" question of 2601.00273);
+  * **availability ratio** — fraction of windows with progress, the
+    liveness-under-disruption headline number;
+  * **recovery time after fault onset** — rounds from the first faulty
+    window (crash/view-change/election activity) to the next window
+    that commits again;
+  * **latency percentiles** — read off the power-of-two bucket
+    histograms (``ops/flight.bucket_counts`` semantics: bucket 0 is
+    <= 0, bucket i covers [2^(i-1), 2^i), the last is overflow; a
+    percentile reports its bucket's LOWER edge — a floor, never an
+    invented interpolation).
+
+Deliberately numpy + stdlib only at module import: the metrics-JSON path
+never pays a jax import (the checkpoint loader resolves engine counter
+names lazily, which does import the engine modules).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+import numpy as np
+
+# Which telemetry counters measure COMMIT progress, per engine — the
+# one declaration; the runner's live -v progress line rates the union
+# (network/runner.PROGRESS_COUNTERS is derived from this dict).
+COMMIT_COUNTERS = {
+    "raft": ("entries_committed",),
+    "raft-sparse": ("entries_committed",),
+    "pbft": ("commit_quorums", "commits_adopted"),
+    "pbft-bcast": ("commit_quorums", "commits_adopted"),
+    "paxos": ("values_learned",),
+    "dpos": ("blocks_appended",),
+}
+# Counters whose first nonzero window marks FAULT ONSET for the
+# recovery-time metric: the §6c crash adversary plus the protocol's own
+# disruption signals (elections / view changes are what an availability
+# attack looks like from inside the protocol).
+FAULT_COUNTERS = ("crashes", "nodes_down", "leader_elections",
+                  "view_changes")
+
+
+@dataclasses.dataclass(frozen=True)
+class Timeline:
+    """One run's flight-recorder series, loaded host-side."""
+    engine: str
+    window_rounds: int
+    n_windows: int
+    n_rounds: int
+    bucket_lo: tuple[int, ...]
+    windows: dict[str, np.ndarray]    # counter -> i64[n_sweeps, n_windows]
+    latency: dict[str, np.ndarray]    # name -> i64[n_sweeps, N_BUCKETS]
+
+    @property
+    def n_sweeps(self) -> int:
+        return next(iter(self.windows.values())).shape[0]
+
+    def rounds_in_window(self) -> np.ndarray:
+        """Per-window round count — every window spans
+        ``window_rounds`` rounds except a ragged last one."""
+        full = np.full(self.n_windows, self.window_rounds, dtype=np.int64)
+        full[-1] = self.n_rounds - (self.n_windows - 1) * self.window_rounds
+        return full
+
+
+def from_flight_dict(fl: dict[str, Any]) -> Timeline:
+    """Build a :class:`Timeline` from ``RunResult.extras["flight"]`` (or
+    the identical ``"flight"`` block of a ``--metrics-out`` JSON)."""
+    return Timeline(
+        engine=fl["engine"],
+        window_rounds=int(fl["window_rounds"]),
+        n_windows=int(fl["n_windows"]),
+        n_rounds=int(fl["n_rounds"]),
+        bucket_lo=tuple(int(b) for b in fl["bucket_lo"]),
+        windows={k: np.asarray(v, dtype=np.int64)
+                 for k, v in fl["windows"].items()},
+        latency={k: np.asarray(v, dtype=np.int64)
+                 for k, v in fl["latency"].items()})
+
+
+def from_metrics_json(path) -> Timeline:
+    """Load the ``"flight"`` block of a ``--metrics-out`` snapshot."""
+    with open(path) as fp:
+        doc = json.load(fp)
+    fl = doc.get("flight")
+    if fl is None:
+        raise ValueError(
+            f"{path}: no 'flight' block — the run was made without "
+            "--telemetry-window (the recorder is off by default)")
+    return from_flight_dict(fl)
+
+
+def from_checkpoint(path) -> Timeline:
+    """Load the window ring + latency histograms from a RECORDER-ON
+    checkpoint (.npz): the ring rides the snapshot as its last two
+    leaves when the saved config has ``telemetry_window > 0``.
+
+    A MID-RUN snapshot covers only rounds ``[0, next_round)`` — the
+    returned timeline is truncated to the executed windows (its
+    ``n_rounds``/``n_windows`` reflect ``next_round``, not the config's
+    full horizon), so never-executed windows cannot read as stalls and
+    deflate availability.
+
+    Resolving the counter/latency NAMES needs the engine declaration,
+    so this path lazily imports the engine modules (and therefore jax)
+    — the metrics-JSON path stays import-free."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        cfg_d = meta["config"]
+        next_round = int(meta["next_round"])
+        if not cfg_d.get("telemetry_window"):
+            raise ValueError(
+                f"{path}: snapshot was written with the flight recorder "
+                "off (telemetry_window = 0) — no series to load")
+        n_leaves = len([k for k in z.files if k.startswith("leaf_")])
+        win = np.asarray(z[f"leaf_{n_leaves - 2}"])
+        lat = np.asarray(z[f"leaf_{n_leaves - 1}"])
+
+    from ..core.config import Config
+    from ..network import simulator
+    cfg = Config.from_json(json.dumps(
+        {k: v for k, v in cfg_d.items() if k != "_cutoffs"}))
+    eng = simulator.engine_def(cfg)
+    from ..network.runner import n_windows as _nw
+    from ..ops.flight import BUCKET_LO
+    nw = _nw(cfg)
+    if win.shape != (cfg.n_sweeps, nw, len(eng.telemetry_names)) \
+            or lat.shape != (cfg.n_sweeps, len(eng.latency_names),
+                             len(BUCKET_LO)):
+        raise ValueError(
+            f"{path}: trailing leaves {win.shape}/{lat.shape} do not "
+            "match the flight-recorder schema for the saved config — "
+            "not a recorder-on snapshot of this code version")
+    # Truncate to the executed prefix: rounds [0, next_round) fill
+    # exactly ceil(next_round / W) windows (a checkpoint lands on a
+    # chunk boundary, but the last executed window may still be
+    # partial when W doesn't divide the chunk size).
+    W = cfg.telemetry_window
+    n_rounds = min(next_round, cfg.n_rounds)
+    nwe = max(1, -(-n_rounds // W))
+    return Timeline(
+        engine=eng.name, window_rounds=W,
+        n_windows=nwe, n_rounds=n_rounds, bucket_lo=BUCKET_LO,
+        windows={name: win[:, :nwe, k].astype(np.int64)
+                 for k, name in enumerate(eng.telemetry_names)},
+        latency={name: lat[:, h, :].astype(np.int64)
+                 for h, name in enumerate(eng.latency_names)})
+
+
+def _commit_series(tl: Timeline) -> np.ndarray:
+    """Commit progress per (sweep, window), summed over the engine's
+    commit counters."""
+    names = COMMIT_COUNTERS.get(tl.engine)
+    if names is None:
+        raise ValueError(f"no commit counters declared for engine "
+                         f"{tl.engine!r} (obs/timeline.COMMIT_COUNTERS)")
+    return sum(tl.windows[n] for n in names)
+
+
+def _bucket_quantile(counts: np.ndarray, bucket_lo: tuple[int, ...],
+                     q: float) -> int:
+    """The LOWER edge of the bucket holding the q-quantile observation
+    (a floor on the true quantile; exact to bucket resolution)."""
+    total = int(counts.sum())
+    if total == 0:
+        return 0
+    cum = np.cumsum(counts)
+    return int(bucket_lo[int(np.searchsorted(cum, q * total))])
+
+
+def derive(tl: Timeline) -> dict[str, Any]:
+    """Liveness metrics off one timeline (all JSON-serializable).
+
+    ``availability`` is the fraction of windows with commit progress,
+    per sweep; ``stall_windows`` the complementary count;
+    ``recovery_rounds`` measures, per sweep, from the first
+    fault-active window (:data:`FAULT_COUNTERS`) to the next window
+    that commits at or after it — -1 when the run never recovers, null
+    onset when no fault ever fires.
+    """
+    commits = _commit_series(tl)                 # [B, n_windows]
+    riw = tl.rounds_in_window()                  # [n_windows]
+    stall = commits == 0                         # [B, n_windows]
+    avail = 1.0 - stall.mean(axis=1)
+    rate = commits / riw[None, :]
+
+    fault = np.zeros_like(commits)
+    for name in FAULT_COUNTERS:
+        if name in tl.windows:
+            fault = fault + tl.windows[name]
+    onset: list[int | None] = []
+    recovery: list[int | None] = []
+    for b in range(commits.shape[0]):
+        hot = np.nonzero(fault[b] > 0)[0]
+        if hot.size == 0:
+            onset.append(None)
+            recovery.append(None)
+            continue
+        o = int(hot[0])
+        onset.append(o)
+        prog = np.nonzero(commits[b, o:] > 0)[0]
+        # Rounds from the onset window's START to the END of the first
+        # window that committed again — an upper bound at window
+        # resolution; -1 = never recovered.
+        recovery.append(int(riw[o:o + prog[0] + 1].sum())
+                        if prog.size else -1)
+
+    out: dict[str, Any] = {
+        "engine": tl.engine,
+        "window_rounds": tl.window_rounds,
+        "n_windows": tl.n_windows,
+        "n_sweeps": tl.n_sweeps,
+        "availability": {"per_sweep": [round(float(a), 6) for a in avail],
+                         "mean": round(float(avail.mean()), 6)},
+        "stall_windows": {"per_sweep": [int(s) for s in stall.sum(axis=1)],
+                          "total": int(stall.sum())},
+        "commit_rate_per_round": {
+            "per_window_mean": [round(float(x), 6)
+                                for x in rate.mean(axis=0)],
+            "overall": round(float(commits.sum() / (tl.n_rounds
+                                                    * tl.n_sweeps)), 6)},
+        "fault_onset_window": onset,
+        "recovery_rounds": recovery,
+        "latency": {
+            name: {"count": int(h.sum()),
+                   "p50": _bucket_quantile(h.sum(axis=0), tl.bucket_lo, .5),
+                   "p90": _bucket_quantile(h.sum(axis=0), tl.bucket_lo, .9),
+                   "p99": _bucket_quantile(h.sum(axis=0), tl.bucket_lo, .99)}
+            for name, h in tl.latency.items()},
+    }
+    return out
+
+
+def export_metrics(derived: dict[str, Any], registry=None) -> None:
+    """Publish the derived liveness metrics as gauges on the process
+    metrics registry (default: the one ``--metrics-out`` snapshots), so
+    a dashboard scrape carries the timeline verdicts, not just raw
+    series."""
+    from . import metrics as obs_metrics
+    reg = registry if registry is not None else obs_metrics.REGISTRY
+    reg.gauge("timeline_availability_ratio").set(
+        derived["availability"]["mean"])
+    reg.gauge("timeline_stall_windows_total").set(
+        derived["stall_windows"]["total"])
+    reg.gauge("timeline_commit_rate_per_round").set(
+        derived["commit_rate_per_round"]["overall"])
+    rec = [r for r in derived["recovery_rounds"] if r is not None]
+    if rec:
+        # -1 = some sweep NEVER recovered: the worst liveness outcome
+        # must be visible on a scrape, not indistinguishable from a
+        # fault-free run (which exports no recovery gauge at all).
+        reg.gauge("timeline_recovery_rounds_max").set(
+            -1 if any(r < 0 for r in rec) else max(rec))
+    for name, d in derived["latency"].items():
+        reg.gauge(f"timeline_latency_{name}_p90").set(d["p90"])
+
+
+def render_text(tl: Timeline, derived: dict[str, Any]) -> str:
+    """Compact terminal summary of one timeline."""
+    commits = _commit_series(tl)
+    lines = [
+        f"flight recorder: engine={tl.engine} "
+        f"windows={tl.n_windows}x{tl.window_rounds}r "
+        f"({tl.n_rounds} rounds, {tl.n_sweeps} sweeps)",
+        f"availability {derived['availability']['mean']:.3f} "
+        f"(per sweep: "
+        f"{' '.join(f'{a:.3f}' for a in derived['availability']['per_sweep'])})"
+        f" | stall windows {derived['stall_windows']['total']}"
+        f" | commit rate {derived['commit_rate_per_round']['overall']:.3f}"
+        f"/round",
+    ]
+    for b in range(tl.n_sweeps):
+        o, r = derived["fault_onset_window"][b], derived["recovery_rounds"][b]
+        tail = "no faults" if o is None else (
+            f"fault onset w{o}, " + ("never recovered" if r < 0
+                                     else f"recovered in <= {r} rounds"))
+        lines.append(f"  sweep {b}: commits/window "
+                     f"{' '.join(str(int(c)) for c in commits[b])}  [{tail}]")
+    for name, d in derived["latency"].items():
+        h = tl.latency[name].sum(axis=0)
+        lines.append(f"  latency {name}: n={d['count']} p50>={d['p50']} "
+                     f"p90>={d['p90']} p99>={d['p99']} rounds "
+                     f"(buckets {' '.join(str(int(c)) for c in h)})")
+    return "\n".join(lines)

@@ -1,0 +1,46 @@
+"""Flight-recorder device kernels (docs/OBSERVABILITY.md §"Flight recorder").
+
+On-device protocol *latency* histograms: each engine reduces a handful
+of per-round duration observations (election waits, slot time-to-commit,
+rounds-to-learn, ...) into fixed power-of-two buckets INSIDE the scan
+body, so the time structure of a 100k-round run survives without ever
+shipping per-round data to the host. Like the telemetry counters the
+observations are read off the round's own intermediates and never feed
+back into state — enabling them is digest-neutral by construction
+(tests/test_flight.py pins bit-identity per engine).
+
+Bucket semantics (``N_BUCKETS`` = 16, shared by every engine and by the
+``tools/validate_trace.py`` schema): bucket 0 holds observations <= 0,
+bucket i (1 <= i <= 14) holds values in [2^(i-1), 2^i), and the last
+bucket is the >= 2^14 overflow. All-integer compares — no float log2,
+so bucket placement can never drift across backends.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+N_BUCKETS = 16
+# Lower-inclusive bucket edges: (0, 1, 2, 4, ..., 2^14); the last bucket
+# is open-ended. Exported so the host-side schema (validate_trace /
+# obs/timeline) states the same integers the device compares against.
+BUCKET_LO = (0,) + tuple(2 ** i for i in range(N_BUCKETS - 1))
+
+
+def bucket_counts(values, mask):
+    """Histogram of ``values`` where ``mask``, as ``i32[N_BUCKETS]``.
+
+    ``values`` is any-shape i32 observations; ``mask`` broadcasts
+    against it (False lanes contribute nothing). Computed as 15 masked
+    threshold reductions + differencing — vectorized fused passes, never
+    a one-hot ``[..., N_BUCKETS]`` materialization (at the pbft [N, S]
+    shapes that intermediate would be ~100s of MB per round) and never
+    a scatter-add (the serial scatter unit, docs/PERF.md).
+    """
+    v, m = jnp.broadcast_arrays(jnp.asarray(values, jnp.int32), mask)
+    v = v.astype(jnp.int32)
+    total = jnp.sum(m.astype(jnp.int32))
+    ge = jnp.stack([jnp.sum((m & (v >= t)).astype(jnp.int32))
+                    for t in BUCKET_LO[1:]])          # [N_BUCKETS-1]
+    lo = jnp.concatenate([total[None], ge])
+    hi = jnp.concatenate([ge, jnp.zeros((1,), jnp.int32)])
+    return lo - hi

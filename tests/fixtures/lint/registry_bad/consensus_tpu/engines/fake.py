@@ -9,6 +9,10 @@ from ..ops.adversary import CRASH_TELEMETRY, crash_transition, freeze_down
 
 FAKE_TELEMETRY = ("good_counter", "rogue_counter") + CRASH_TELEMETRY
 
+# Latency-registry drift: 'rogue_hist' is unknown to the validator's
+# LATENCY_HISTOGRAMS and its 'stale_hist' is recorded by no engine.
+FAKE_LATENCY = ("good_hist", "rogue_hist")
+
 
 class FakeState(NamedTuple):
     seed: object

@@ -1,5 +1,9 @@
 """Fixture validator registry: 'stale_counter' is reported by no engine
-and the engine's 'rogue_counter' is missing here."""
+and the engine's 'rogue_counter' is missing here — and the same pair of
+drifts seeded for the flight-recorder latency registry."""
 TELEMETRY_COUNTERS = frozenset({
     "good_counter", "stale_counter", "crashes",
+})
+LATENCY_HISTOGRAMS = frozenset({
+    "good_hist", "stale_hist",
 })

@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""Measure flight-recorder overhead at the flagship shapes
+(docs/OBSERVABILITY.md §"Recorder overhead").
+
+Interleaved off/on repeats (off, on, off, on, ...) with one warmup per
+variant first, reporting the min wall of each — sequential measurement
+is dominated by machine-load drift (the PR 2 overhead table's caveat).
+
+    JAX_PLATFORMS=cpu python tools/flight_overhead.py [--repeats 5] \
+        [--window 8] [--configs raft-100k,pbft-100k-bcast]
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--window", type=int, default=8)
+    ap.add_argument("--configs", default="raft-100k,pbft-100k-bcast")
+    args = ap.parse_args(argv)
+
+    from benchmarks.run_benchmarks import CONFIGS
+    from consensus_tpu.network import simulator
+
+    for name in args.configs.split(","):
+        off = CONFIGS[name]
+        on = dataclasses.replace(off, telemetry_window=args.window)
+        variants = {"off": (off, {}), "on": (on, {"telemetry": True})}
+        walls: dict[str, list[float]] = {"off": [], "on": []}
+        for key, (cfg, kw) in variants.items():  # compile + warm both
+            simulator.run(cfg, warmup=True, **kw)
+        for rep in range(args.repeats):
+            for key, (cfg, kw) in variants.items():
+                t0 = time.perf_counter()
+                simulator.run(cfg, warmup=False, **kw)
+                walls[key].append(time.perf_counter() - t0)
+            print(f"  {name} rep {rep}: off={walls['off'][-1]:.3f}s "
+                  f"on={walls['on'][-1]:.3f}s", file=sys.stderr)
+        off_s, on_s = min(walls["off"]), min(walls["on"])
+        print(f"{name}: off={off_s * 1e3:.1f} ms  "
+              f"on(W={args.window})={on_s * 1e3:.1f} ms  "
+              f"delta={100 * (on_s - off_s) / off_s:+.1f} %")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

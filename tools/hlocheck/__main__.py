@@ -74,18 +74,29 @@ def run_checks(only: list[str] | None = None, update: bool = False) -> int:
         con = cons[eng.name]
         # f-ladder targets are ONE dispatch (no chunked cross-dispatch
         # carry), so their donation contract is trivially zero leaves.
+        # Flight-recorder targets donate the telemetry accumulator +
+        # window ring + latency histograms on top of the carry; those
+        # three riders sit AFTER the undonated r0 scalar in the entry-
+        # parameter order, so the expected donated set is not a prefix.
+        donated_params = None
         leaves = 0 if tgt.fsweep else hlo.n_carry_leaves(tgt.cfg, eng)
+        if tgt.flight:
+            donated_params = list(range(leaves)) + [leaves + 1 + i
+                                                    for i in range(3)]
+            leaves += 3
         variants: dict[str, dict] = {}
         bad = False
         for var in tgt.variants:
             t0 = time.perf_counter()
             rep = (hlo.fsweep_compiled_report(tgt.cfg, tgt.fsweep)
                    if tgt.fsweep
-                   else hlo.compiled_report(tgt.cfg, eng, var.mesh_shape))
+                   else hlo.compiled_report(tgt.cfg, eng, var.mesh_shape,
+                                            flight=tgt.flight))
             viol = contracts.check_module(
                 rep, con, tgt.cfg, mode=var.mode, axis=var.axis,
                 carry_leaves=leaves,
-                enforce_budgets=var.mesh_shape is None)
+                enforce_budgets=var.mesh_shape is None,
+                donated_params=donated_params)
             verd = contracts.verdicts(viol)
             variants[var.key] = fingerprint.variant_entry(
                 var, rep, verd, leaves)

@@ -215,13 +215,18 @@ def _check_host_boundary(rep: hlo.ModuleReport,
     return out
 
 
-def _check_donation(rep: hlo.ModuleReport, leaves: int) -> list[Violation]:
+def _check_donation(rep: hlo.ModuleReport, leaves: int,
+                    donated_params: list[int] | None = None
+                    ) -> list[Violation]:
     donated = sorted(p for _, p in rep.donation)
-    if donated != list(range(leaves)):
+    want = sorted(donated_params) if donated_params is not None \
+        else list(range(leaves))
+    if donated != want:
         return [Violation(
             "donation",
             f"carry not (fully) donated: {len(donated)}/{leaves} input "
-            f"buffers aliased (params {donated[:8]}{'...' if len(donated) > 8 else ''}) "
+            f"buffers aliased (params {donated[:8]}{'...' if len(donated) > 8 else ''}, "
+            f"expected {want[:8]}{'...' if len(want) > 8 else ''}) "
             f"— the chunked carry must reuse its buffers across "
             f"dispatches (runner._chunk_jit donate_argnums)")]
     return []
@@ -230,7 +235,9 @@ def _check_donation(rep: hlo.ModuleReport, leaves: int) -> list[Violation]:
 def check_module(rep: hlo.ModuleReport, con: EngineContract, cfg, *,
                  mode: str | None, axis: str | None,
                  carry_leaves: int,
-                 enforce_budgets: bool = True) -> list[Violation]:
+                 enforce_budgets: bool = True,
+                 donated_params: list[int] | None = None
+                 ) -> list[Violation]:
     """Evaluate all five contracts against one compiled module.
 
     ``mode``/``axis`` describe the variant (None = single device;
@@ -238,14 +245,18 @@ def check_module(rep: hlo.ModuleReport, con: EngineContract, cfg, *,
     for meshed variants: the partitioner legitimately splits one logical
     sort into per-shard sort + merge passes, so budgets pin the
     single-device program the benchmarks dispatch (mesh counts are still
-    recorded in the fingerprint).
+    recorded in the fingerprint). ``donated_params`` overrides the
+    expected donated entry-parameter indices (default
+    ``range(carry_leaves)``) — recorder-ON programs donate the carry
+    leaves PLUS the telem/win/lat riders, which sit after the undonated
+    ``r0`` scalar in the entry-parameter order.
     """
     out = _check_collectives(rep, con, mode, axis, cfg)
     if enforce_budgets:
         out += _check_sort_budget(rep, con)
     out += _check_dtypes(rep)
     out += _check_host_boundary(rep, con)
-    out += _check_donation(rep, carry_leaves)
+    out += _check_donation(rep, carry_leaves, donated_params)
     return out
 
 

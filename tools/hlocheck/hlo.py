@@ -199,15 +199,33 @@ def n_carry_leaves(cfg, eng) -> int:
     return len(jax.tree.leaves(carry_struct(cfg, eng)))
 
 
+def flight_structs(cfg, eng):
+    """ShapeDtypeStructs of the flight recorder's (telem, win, lat)
+    scan-riders for ``cfg`` (``cfg.telemetry_window`` must be > 0) —
+    what a recorder-ON target lowers ``_chunk_jit`` with. The win/lat
+    geometry comes from ``runner.flight_structs`` (the one declaration
+    the dispatch path also uses), so the fingerprinted program cannot
+    drift from the dispatched one."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_tpu.network import runner
+    telem = jax.ShapeDtypeStruct(
+        (cfg.n_sweeps, len(eng.telemetry_names)), jnp.int32)
+    return (telem,) + tuple(runner.flight_structs(cfg, eng))
+
+
 def compiled_text(cfg, eng=None, mesh_shape=None, *, jit_fn=None,
-                  mesh=None) -> str:
+                  mesh=None, flight: bool = False) -> str:
     """Compiled (post-GSPMD, post-optimization) HLO text of one
     production round-loop chunk: ``runner._chunk_jit.lower(...)
     .compile().as_text()`` over eval_shape structs — trace time only.
 
     ``jit_fn`` substitutes another jit with the same signature (the
     un-donated fixture twin); ``mesh`` passes a prebuilt Mesh (fixtures
-    close over one), else ``mesh_shape`` builds it.
+    close over one), else ``mesh_shape`` builds it. ``flight=True``
+    lowers the recorder-ON program (telemetry accumulator + window ring
+    + latency histograms riding the scan — :func:`flight_structs`).
     """
     import jax
     import jax.numpy as jnp
@@ -221,14 +239,16 @@ def compiled_text(cfg, eng=None, mesh_shape=None, *, jit_fn=None,
     carry = carry_struct(cfg, eng)
     r0 = jax.ShapeDtypeStruct((), jnp.int32)
     fn = jit_fn if jit_fn is not None else runner._chunk_jit
-    lowered = fn.lower(cfg, eng, chunk_rounds(cfg), carry, r0, mesh=mesh)
+    extra = flight_structs(cfg, eng) if flight else ()
+    lowered = fn.lower(cfg, eng, chunk_rounds(cfg), carry, r0, *extra,
+                       mesh=mesh)
     return lowered.compile().as_text()
 
 
 def compiled_report(cfg, eng=None, mesh_shape=None, *, jit_fn=None,
-                    mesh=None) -> ModuleReport:
+                    mesh=None, flight: bool = False) -> ModuleReport:
     return analyze(compiled_text(cfg, eng, mesh_shape, jit_fn=jit_fn,
-                                 mesh=mesh))
+                                 mesh=mesh, flight=flight))
 
 
 def fsweep_compiled_text(cfg, fs) -> str:

@@ -62,6 +62,12 @@ class Target:
     # carry exists, so its donation contract sees zero carry leaves by
     # construction (tools/hlocheck/__main__).
     fsweep: tuple[int, ...] | None = None
+    # True = lower the FLIGHT-RECORDER-ON program (cfg.telemetry_window
+    # must be > 0): the telemetry accumulator + window ring + latency
+    # histograms ride the scan and count as three extra donated leaves.
+    # Pins that the recorder does not reintroduce sort/cumsum-class ops
+    # against the engine's (lowered) budgets.
+    flight: bool = False
 
 
 SINGLE = Variant("single", None, None)
@@ -88,6 +94,15 @@ PBFT_BCAST_FSWEEP = Config(protocol="pbft", fault_model="bcast", f=1,
                            n_nodes=4, n_rounds=64, n_sweeps=1,
                            log_capacity=16, seed=7, **ADV)
 
+# The recorder-ON flagship program (docs/OBSERVABILITY.md §"Flight
+# recorder"): pbft-100k-bcast — the one engine whose sort diet (PR 8)
+# the windows must not undo — with an 8-round window. The recorder-OFF
+# program is pinned by the plain pbft-100k-bcast fingerprint staying
+# byte-stable (the static no-op); this target pins the ON program to
+# the same sort_budget=1 / cumsum_budget=20 ceilings.
+PBFT_BCAST_FLIGHT = dataclasses.replace(FLAGSHIP_CONFIGS["pbft-100k-bcast"],
+                                        telemetry_window=8)
+
 
 def targets() -> tuple[Target, ...]:
     F = FLAGSHIP_CONFIGS
@@ -98,6 +113,8 @@ def targets() -> tuple[Target, ...]:
                (SINGLE, Variant("node2x4", (2, 4), "bounded", "node"),
                 SWEEP8)),
         Target("pbft-100k-bcast", F["pbft-100k-bcast"], (SINGLE, SWEEP8)),
+        Target("pbft-100k-bcast-flight", PBFT_BCAST_FLIGHT, (SINGLE,),
+               flight=True),
         Target("pbft-100k-bcast-fsweep", PBFT_BCAST_FSWEEP, (SINGLE,),
                fsweep=FSWEEP_BCAST_FS),
         Target("paxos-10kx10k", F["paxos-10kx10k"], (SINGLE,)),

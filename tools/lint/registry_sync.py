@@ -58,11 +58,11 @@ def _module_str_tuples(tree: ast.Module, env: dict) -> dict[str, tuple]:
     return out
 
 
-def _validator_counters(repo: Repo) -> tuple[set, int] | None:
+def _validator_registry(repo: Repo, var: str) -> tuple[set, int] | None:
     for node in repo.tree(VALIDATOR).body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
-                and node.targets[0].id == "TELEMETRY_COUNTERS":
+                and node.targets[0].id == var:
             names = {c.value for c in ast.walk(node.value)
                      if isinstance(c, ast.Constant)
                      and isinstance(c.value, str)}
@@ -70,13 +70,20 @@ def _validator_counters(repo: Repo) -> tuple[set, int] | None:
     return None
 
 
-def _telemetry_violations(repo: Repo) -> list[Violation]:
+def _names_violations(repo: Repo, *, suffix: str, var: str, kind: str,
+                      extra_env: tuple[str, ...] = ()) -> list[Violation]:
+    """Two-way sync of the engines' ``*_{suffix}`` name tuples against
+    the import-free ``{var}`` registry in tools/validate_trace.py —
+    shared by the telemetry-counter and flight-recorder-latency
+    registries (both drift the same way: a renamed engine name makes
+    the validator reject fresh CLI reports, a stale registry entry
+    silently matches nothing)."""
     if not repo.exists(VALIDATOR):
         return [repo.missing(CHECK, VALIDATOR)]
-    got = _validator_counters(repo)
+    got = _validator_registry(repo, var)
     if got is None:
         return [Violation(CHECK, VALIDATOR, 0,
-                          "no TELEMETRY_COUNTERS registry found")]
+                          f"no {var} registry found")]
     registry, reg_line = got
     env: dict[str, tuple] = {}
     if repo.exists(ADVERSARY):
@@ -86,22 +93,36 @@ def _telemetry_violations(repo: Repo) -> list[Violation]:
     for rel in repo.glob(ENGINES_GLOB):
         tuples = _module_str_tuples(repo.tree(rel), env)
         for name, val in tuples.items():
-            if name.endswith("TELEMETRY"):
+            if name.endswith(suffix):
                 engine_names.update(val)
                 for counter in val:
                     if counter not in registry:
                         errs.append(Violation(
                             CHECK, rel, 0,
-                            f"telemetry counter {counter!r} ({name}) is "
-                            f"missing from {VALIDATOR} TELEMETRY_COUNTERS "
+                            f"{kind} {counter!r} ({name}) is "
+                            f"missing from {VALIDATOR} {var} "
                             "— the CLI-report tripwire would reject it"))
-    engine_names.update(env.get("CRASH_TELEMETRY", ()))
+    for key in extra_env:
+        engine_names.update(env.get(key, ()))
     for counter in sorted(registry - engine_names):
         errs.append(Violation(
             CHECK, VALIDATOR, reg_line,
-            f"TELEMETRY_COUNTERS entry {counter!r} is reported by no "
+            f"{var} entry {counter!r} is reported by no "
             "engine — stale registry entry"))
     return errs
+
+
+def _telemetry_violations(repo: Repo) -> list[Violation]:
+    return _names_violations(repo, suffix="TELEMETRY",
+                             var="TELEMETRY_COUNTERS",
+                             kind="telemetry counter",
+                             extra_env=("CRASH_TELEMETRY",))
+
+
+def _latency_violations(repo: Repo) -> list[Violation]:
+    return _names_violations(repo, suffix="LATENCY",
+                             var="LATENCY_HISTOGRAMS",
+                             kind="latency histogram")
 
 
 # --- CRASH_SPLIT -----------------------------------------------------------
@@ -266,4 +287,5 @@ def _crash_split_violations(repo: Repo) -> list[Violation]:
 
 
 def check(repo: Repo) -> list[Violation]:
-    return _telemetry_violations(repo) + _crash_split_violations(repo)
+    return (_telemetry_violations(repo) + _latency_violations(repo)
+            + _crash_split_violations(repo))

@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""teleview — render a flight-recorder timeline (docs/OBSERVABILITY.md
+§"Flight recorder").
+
+    python -m tools.teleview --metrics metrics.json
+    python -m tools.teleview --checkpoint ck.npz --json
+    python -m tools.teleview --metrics metrics.json --prom derived.prom
+
+Loads the windowed telemetry series + protocol latency histograms a
+``--telemetry-window`` run left behind (the ``"flight"`` block of a
+``--metrics-out`` snapshot, or a recorder-on checkpoint's trailing
+leaves), derives the liveness metrics (commit throughput per window,
+stall windows, availability ratio, recovery time after fault onset,
+latency percentiles — :mod:`consensus_tpu.obs.timeline`), and prints a
+text summary (default) or the derived-metrics JSON (``--json``).
+``--prom`` additionally writes the derived gauges in Prometheus text
+format, so a scrape carries the timeline verdicts.
+
+The metrics-JSON path imports numpy + the obs package only (no jax);
+the checkpoint path resolves engine counter names and pays the jax
+import.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.teleview",
+        description="Timeline analysis of flight-recorder series "
+                    "(windowed telemetry + latency histograms).")
+    ap.add_argument("--metrics", default="",
+                    help="a --metrics-out JSON snapshot with a 'flight' "
+                         "block (the run must have used "
+                         "--telemetry-window)")
+    ap.add_argument("--checkpoint", default="",
+                    help="a recorder-on checkpoint .npz (the ring rides "
+                         "the snapshot; imports jax to resolve names)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the derived-metrics JSON instead of the "
+                         "text summary")
+    ap.add_argument("--prom", default="",
+                    help="also write the derived gauges as Prometheus "
+                         "text to this path")
+    args = ap.parse_args(argv)
+    if bool(args.metrics) == bool(args.checkpoint):
+        ap.error("pass exactly one of --metrics / --checkpoint")
+
+    from consensus_tpu.obs import timeline
+    try:
+        tl = (timeline.from_metrics_json(args.metrics) if args.metrics
+              else timeline.from_checkpoint(args.checkpoint))
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"teleview: {exc}", file=sys.stderr)
+        return 1
+    derived = timeline.derive(tl)
+    if args.prom:
+        from consensus_tpu.obs import metrics as obs_metrics
+        reg = obs_metrics.Registry()
+        timeline.export_metrics(derived, registry=reg)
+        pathlib.Path(args.prom).write_text(reg.to_prometheus())
+    if args.json:
+        print(json.dumps(derived, indent=2, sort_keys=True))
+    else:
+        print(timeline.render_text(tl, derived))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

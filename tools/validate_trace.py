@@ -43,6 +43,34 @@ TELEMETRY_COUNTERS = frozenset({
     "crashes", "recoveries", "nodes_down",
 })
 
+# Every flight-recorder protocol-latency histogram any engine may record
+# (docs/OBSERVABILITY.md §"Flight recorder"; the *_LATENCY tuples
+# registered as EngineDef.latency_names — lint-synced both ways like
+# TELEMETRY_COUNTERS).
+LATENCY_HISTOGRAMS = frozenset({
+    # raft (dense + sparse)
+    "election_wait_rounds", "commit_lag_rounds",
+    # pbft (edge + bcast)
+    "view_change_wait_rounds", "slot_commit_rounds",
+    # paxos
+    "rounds_to_learn",
+    # dpos
+    "chain_lag_rounds",
+})
+
+# Flight-recorder bucket semantics (ops/flight.py): bucket 0 holds
+# observations <= 0, bucket i covers [2^(i-1), 2^i), last is overflow.
+N_LATENCY_BUCKETS = 16
+LATENCY_BUCKET_LO = [0] + [2 ** i for i in range(N_LATENCY_BUCKETS - 1)]
+
+# The CLI report's `flight` summary block — exactly these keys, like
+# CHECKPOINT_IO_FIELDS (the full windowed series lives in the
+# --metrics-out artifact's "flight" block, not the one-line report).
+FLIGHT_REPORT_FIELDS = frozenset({
+    "window_rounds", "n_windows", "availability", "stall_windows",
+    "latency",
+})
+
 # Every span/event name a framework emitter may write (the
 # docs/OBSERVABILITY.md span inventory). Traces may also carry
 # caller-defined names (validate_trace stays name-agnostic for them);
@@ -207,6 +235,84 @@ def _validate_histogram(name: str, d: dict) -> list:
     return errs
 
 
+def _int_rows(name: str, v, n_cols: int, n_rows: int | None) -> list:
+    """``v`` must be a list of equal-length rows of ints >= 0 —
+    ``n_cols`` wide, ``n_rows`` tall when known (None = any)."""
+    if not isinstance(v, list) or not v \
+            or not all(isinstance(row, list) for row in v):
+        return [f"{name}: must be a non-empty list of rows"]
+    errs = []
+    if n_rows is not None and len(v) != n_rows:
+        errs.append(f"{name}: {len(v)} rows != n_sweeps {n_rows}")
+    for row in v:
+        if len(row) != n_cols:
+            errs.append(f"{name}: row of width {len(row)} != {n_cols}")
+            break
+        if not all(isinstance(c, int) and not isinstance(c, bool)
+                   and c >= 0 for c in row):
+            errs.append(f"{name}: entries must be ints >= 0")
+            break
+    return errs
+
+
+def validate_flight(path, fl) -> list:
+    """Schema checks for the flight-recorder block of a --metrics-out
+    snapshot (docs/OBSERVABILITY.md §"Flight recorder"): window/bucket
+    geometry, and counter/histogram names against the known-name
+    registries (drift between the engines and this tripwire fails)."""
+    if not isinstance(fl, dict):
+        return [f"{path}: 'flight' must be an object"]
+    errs = []
+    for key in ("engine", "window_rounds", "n_windows", "n_rounds",
+                "bucket_lo", "windows", "latency"):
+        if key not in fl:
+            errs.append(f"{path}: flight missing key {key!r}")
+    for key in ("window_rounds", "n_windows", "n_rounds"):
+        v = fl.get(key)
+        if key in fl and (not isinstance(v, int) or isinstance(v, bool)
+                          or v < 1):
+            errs.append(f"{path}: flight.{key} must be an int >= 1")
+    W, nw, nr = (fl.get(k) for k in ("window_rounds", "n_windows",
+                                     "n_rounds"))
+    if all(isinstance(x, int) and x >= 1 for x in (W, nw, nr)) \
+            and nw != -(-nr // W):
+        errs.append(f"{path}: flight.n_windows {nw} != "
+                    f"ceil(n_rounds/window_rounds) = {-(-nr // W)}")
+    if "bucket_lo" in fl and fl["bucket_lo"] != LATENCY_BUCKET_LO:
+        errs.append(f"{path}: flight.bucket_lo != the power-of-two edges "
+                    f"{LATENCY_BUCKET_LO} (ops/flight.py semantics)")
+    n_sweeps = None
+    windows = fl.get("windows")
+    if windows is not None and not isinstance(windows, dict):
+        errs.append(f"{path}: flight.windows must be an object")
+        windows = None
+    if isinstance(windows, dict):
+        for name, v in sorted(windows.items()):
+            if name not in TELEMETRY_COUNTERS:
+                errs.append(f"{path}: flight window counter {name!r} is "
+                            "not in the known-name registry (engines and "
+                            "validator drifted?)")
+            sub = _int_rows(f"flight.windows.{name}", v,
+                            nw if isinstance(nw, int) else 0, n_sweeps)
+            errs += [f"{path}: {e}" for e in sub]
+            if not sub and n_sweeps is None:
+                n_sweeps = len(v)
+    latency = fl.get("latency")
+    if latency is not None and not isinstance(latency, dict):
+        errs.append(f"{path}: flight.latency must be an object")
+        latency = None
+    if isinstance(latency, dict):
+        for name, v in sorted(latency.items()):
+            if name not in LATENCY_HISTOGRAMS:
+                errs.append(f"{path}: flight latency histogram {name!r} "
+                            "is not in the known-name registry (engines "
+                            "and validator drifted?)")
+            errs += [f"{path}: {e}"
+                     for e in _int_rows(f"flight.latency.{name}", v,
+                                        N_LATENCY_BUCKETS, n_sweeps)]
+    return errs
+
+
 def validate_metrics(path) -> list:
     """Return a list of violation strings (empty = valid snapshot)."""
     try:
@@ -237,6 +343,8 @@ def validate_metrics(path) -> list:
             errs += [f"{path}: {e}" for e in _validate_histogram(name, d)]
         else:
             errs.append(f"{path}: metric {name!r} has unknown type {typ!r}")
+    if "flight" in doc:
+        errs += validate_flight(path, doc["flight"])
     return errs
 
 
@@ -299,6 +407,46 @@ def validate_cli_report(path) -> list:
                     if not _num(v) or v < 0:
                         errs.append(f"{path}: checkpoint_io {key} must be "
                                     "a finite number >= 0")
+    fl = doc.get("flight")
+    if fl is not None:
+        if not isinstance(fl, dict):
+            errs.append(f"{path}: 'flight' must be an object")
+        else:
+            for key in sorted(FLIGHT_REPORT_FIELDS - set(fl)):
+                errs.append(f"{path}: flight missing key {key!r}")
+            for key in sorted(set(fl) - FLIGHT_REPORT_FIELDS):
+                errs.append(f"{path}: flight key {key!r} is not in the "
+                            "known-field registry (CLI report and "
+                            "validator drifted?)")
+            for key, lo in (("window_rounds", 1), ("n_windows", 1),
+                            ("stall_windows", 0)):
+                v = fl.get(key)
+                if key in fl and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < lo):
+                    errs.append(f"{path}: flight.{key} must be an "
+                                f"int >= {lo}")
+            av = fl.get("availability")
+            if "availability" in fl and (not _num(av)
+                                         or not 0.0 <= av <= 1.0):
+                errs.append(f"{path}: flight.availability must be a "
+                            "number in [0, 1]")
+            lat = fl.get("latency")
+            if isinstance(lat, dict):
+                for name, v in sorted(lat.items()):
+                    if name not in LATENCY_HISTOGRAMS:
+                        errs.append(f"{path}: flight latency histogram "
+                                    f"{name!r} is not in the known-name "
+                                    "registry (engines and validator "
+                                    "drifted?)")
+                    if not (isinstance(v, list)
+                            and len(v) == N_LATENCY_BUCKETS
+                            and all(isinstance(c, int)
+                                    and not isinstance(c, bool)
+                                    and c >= 0 for c in v)):
+                        errs.append(f"{path}: flight.latency.{name} must "
+                                    f"be {N_LATENCY_BUCKETS} ints >= 0")
+            elif "latency" in fl:
+                errs.append(f"{path}: flight.latency must be an object")
     tel = doc.get("telemetry")
     if tel is None:
         return errs
