@@ -1,7 +1,7 @@
 # Repo-level entry points. The whole gate is ONE command:
 #
 #   make check     # consensus-lint + hlocheck + ruff + mypy + clang-tidy
-#                  # + tier-1
+#                  # + scenario smoke + tier-1
 #
 # (tools/check.py gates hlocheck on jax and ruff/mypy/clang-tidy on
 # availability and prints a per-layer summary; see
@@ -21,6 +21,9 @@ hlocheck:
 tidy:
 	$(MAKE) -C cpp tidy
 
+scenario-smoke:
+	$(PY) tools/check.py --only scenarios
+
 san-test:
 	$(MAKE) -C cpp san-test
 
@@ -29,4 +32,4 @@ test:
 	  --continue-on-collection-errors -p no:cacheprovider \
 	  -p no:xdist -p no:randomly
 
-.PHONY: check lint hlocheck tidy san-test test
+.PHONY: check lint hlocheck tidy san-test scenario-smoke test

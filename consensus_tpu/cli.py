@@ -34,6 +34,11 @@ _FLAG_FIELDS = {
     "crash_prob": ("crash_prob", 0.0),
     "recover_prob": ("recover_prob", 0.0),
     "max_crashed": ("max_crashed", 0),
+    "miss_rate": ("miss_rate", 0.0),
+    "max_delay_rounds": ("max_delay_rounds", 0),
+    "attack": ("attack", "none"),
+    "attack_rate": ("attack_rate", 1.0),
+    "attack_target": ("attack_target", 0),
     "f": ("f", 1),
     "view_timeout": ("view_timeout", 8),
     "n_byzantine": ("n_byzantine", 0),
@@ -50,19 +55,22 @@ _FLAG_FIELDS = {
 _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
                "fault_model": str, "drop_rate": float,
                "partition_rate": float, "churn_rate": float,
-               "crash_prob": float, "recover_prob": float}
+               "crash_prob": float, "recover_prob": float,
+               "miss_rate": float, "attack": str, "attack_rate": float}
 
 # Config fields with NO native-CLI flag (cpp/consensus_sim.cpp): TPU-
 # engine execution/adversary knobs. The native front door still reaches
 # them for --engine tpu because it re-execs `python3 -m consensus_tpu`
 # BEFORE strict flag parsing; for --engine cpu they are rejected (here
-# or by Config validation — crash_prob is a §6c tpu-only adversary)
-# rather than silently ignored. Machine-checked against both flag
-# surfaces by tools/lint (check `cli`): removing an entry demands a
-# native flag, adding one demands the field really has none.
+# or by Config validation — the SPEC §A.3 targeted attacks are the one
+# remaining tpu-only adversary; §6c crash/§A.1 miss/§A.2 delay are
+# mirrored in the oracle and natively flagged) rather than silently
+# ignored. Machine-checked against both flag surfaces by tools/lint
+# (check `cli`): removing an entry demands a native flag, adding one
+# demands the field really has none.
 NATIVE_CLI_TPU_ONLY = frozenset({
     "mesh_shape", "scan_chunk", "sweep_chunk",
-    "crash_prob", "recover_prob", "max_crashed",
+    "attack", "attack_rate", "attack_target",
     "telemetry_window",
 })
 
@@ -160,6 +168,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "progress line (current-window commit rate + "
                          "ETA, backed by the rounds_completed/sim_eta_s "
                          "gauges)")
+    ap.add_argument("--scenario", default="",
+                    help="run a named scripted-attack scenario from the "
+                         "SPEC Appendix A library "
+                         "(consensus_tpu/scenarios; e.g. "
+                         "repeated-election-disruption, "
+                         "rolling-producer-outage, delay-storm, "
+                         "crash-churn-under-partition): overrides the "
+                         "adversary knobs + protocol, turns the flight "
+                         "recorder on, evaluates the scenario's timeline "
+                         "assertions (availability dip, bounded recovery, "
+                         "DPoS LIB stall) and exits nonzero if they fail; "
+                         "verdict lands in the report under 'scenario'. "
+                         "TPU engine only (the assertions read the flight "
+                         "recorder)")
     ap.add_argument("--config", default="",
                     help="JSON config file; typed flags override its values")
     ap.add_argument("--platform", default="auto",
@@ -274,6 +296,29 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     cfg = args_to_config(args)
 
+    if args.scenario:
+        from . import scenarios
+        if cfg.engine != "tpu":
+            parser.error("--scenario pairs a scripted attack with "
+                         "flight-recorder timeline assertions, which only "
+                         "the TPU engine records (got --engine "
+                         f"{cfg.engine})")
+        if args.fallback_cpu:
+            parser.error("--scenario cannot degrade to the CPU oracle "
+                         "(--fallback-cpu): the oracle records no flight "
+                         "series, so the scenario's timeline assertions "
+                         "would be unjudgeable")
+        # Config fields the user actually typed (SUPPRESS defaults make
+        # them detectable): a scenario protocol switch must reject —
+        # not silently discard — an explicit shape flag.
+        typed = {field for flag, (field, _) in _FLAG_FIELDS.items()
+                 if hasattr(args, flag)}
+        try:
+            args.scenario_def = scenarios.get(args.scenario)
+            cfg = scenarios.apply(cfg, args.scenario_def, explicit=typed)
+        except ValueError as exc:
+            parser.error(str(exc))
+
     if cfg.telemetry_window > 0 and not args.telemetry:
         # The window ring IS the telemetry counters, windowed —
         # --telemetry-window implies --telemetry rather than silently
@@ -355,6 +400,7 @@ def main(argv=None) -> int:
             ("--profile", args.profile),
             ("--retries/--deadline/--fallback-cpu", supervise),
             ("--crash-prob", cfg.crash_prob > 0),
+            ("--scenario", bool(args.scenario)),
             ("--telemetry", args.telemetry),
             ("--telemetry-window", cfg.telemetry_window > 0),
         ] if on]
@@ -616,10 +662,34 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
         report["fallback_used"] = rr["fallback_used"]
         if rr["fallback_used"]:
             report["platform"] = "oracle"
+    verdict = None
+    if args.scenario:
+        # Judge the run against the scenario's timeline bounds; the
+        # verdict rides the report AND the exit status — a failed
+        # assertion is a red build, not a log line.
+        from . import scenarios
+        verdict = scenarios.evaluate(args.scenario_def, result)
+        report["scenario"] = verdict
+        if not verdict["passed"]:
+            failed = [k for k, c in verdict["checks"].items()
+                      if not c["ok"]]
+            print(f"scenario {args.scenario}: FAILED checks: "
+                  f"{', '.join(failed)}", file=sys.stderr)
+            off = scenarios.off_tuned(args.scenario_def, cfg)
+            if off:
+                # The bounds assert a liveness SHAPE, which depends on
+                # population/schedule geometry — off the verified shape
+                # a red verdict is a tuning signal, not proof of a bug.
+                diffs = ", ".join(f"{k}={got} (tuned at {want})"
+                                  for k, (got, want) in sorted(off.items()))
+                print(f"scenario {args.scenario}: note: bounds were "
+                      f"verified at a different shape — {diffs}; at this "
+                      "shape the attack may legitimately show a weaker "
+                      "dip or different recovery", file=sys.stderr)
     if args.verbose:
         _print_verbose(result)
     print(json.dumps(report))
-    return 0
+    return 0 if verdict is None or verdict["passed"] else 3
 
 
 if __name__ == "__main__":

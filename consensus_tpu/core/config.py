@@ -48,15 +48,39 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     partition_rate: float = 0.0  # per round: bipartition active?
     churn_rate: float = 0.0      # per round: all leaders forced to step down
 
-    # Crash-recover adversary (SPEC §6c; tpu engine only — the C++ oracle
-    # does not implement it, so crash_prob > 0 is rejected on engine="cpu"
-    # rather than silently diverging). Per round: each up node crashes
-    # with crash_prob (losing volatile state, capped at max_crashed
-    # simultaneously-down nodes; 0 = no cap) and each down node recovers
-    # with recover_prob, rejoining from its persisted state.
+    # Crash-recover adversary (SPEC §6c; mirrored scalar-for-scalar in
+    # cpp/oracle.cpp since the adversary-library PR, so adversarial
+    # configs stay byte-differential on engine="cpu"). Per round: each
+    # up node crashes with crash_prob (losing volatile state, capped at
+    # max_crashed simultaneously-down nodes; 0 = no cap) and each down
+    # node recovers with recover_prob, rejoining from its persisted
+    # state.
     crash_prob: float = 0.0
     recover_prob: float = 0.0
     max_crashed: int = 0
+
+    # SPEC Appendix A adversary library.
+    # §A.1 per-producer DPoS slot faults: round r's scheduled producer p
+    # misses its slot (skipped chain-wide, like churn) with miss_rate,
+    # drawn per (round, producer) — the per-producer keying is what
+    # makes LIB stall under gappy schedules. dpos only; mirrored.
+    miss_rate: float = 0.0
+    # §A.2 bounded message delay/reorder: a drop on edge i->j at round q
+    # may be repaired by a retransmission landing at q+d, d <= this (a
+    # pure re-draw against shifted round keys — no queue rides the
+    # carry). 0 = off (byte-identical program); capped at 16 (the
+    # delayed-open check is a D-deep static loop per edge). All
+    # protocols; mirrored.
+    max_delay_rounds: int = 0
+    # §A.3 targeted Raft attacks (raft/raft-sparse, TPU engine only —
+    # NOT mirrored; rejected on engine="cpu"): "none" | "elect"
+    # (repeated election disruption: jam all election traffic exactly
+    # when a timeout fires) | "sticky" (leader-stickiness abuse:
+    # suppress step-down of attack_target by jamming its inbound
+    # delivery). attack_rate gates activation per round.
+    attack: str = "none"
+    attack_rate: float = 1.0
+    attack_target: int = 0
 
     # PBFT.
     f: int = 1                   # byzantine tolerance; n_nodes = 3f+1
@@ -131,11 +155,41 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
         if self.max_crashed < 0 or self.max_crashed > self.n_nodes:
             raise ValueError("max_crashed must be in [0, n_nodes] "
                              "(0 = no cap on simultaneous crashes)")
-        if self.crash_prob > 0 and self.engine == "cpu":
+        if self.miss_rate > 0 and self.protocol != "dpos":
             raise ValueError(
-                "crash_prob > 0 is a tpu-engine adversary (SPEC §6c); the "
-                "C++ oracle does not implement it and would silently "
-                "simulate different trajectories")
+                "miss_rate is the SPEC §A.1 per-producer DPoS slot-fault "
+                f"adversary; {self.protocol} has no producer schedule and "
+                "would silently ignore it")
+        if not (0 <= self.max_delay_rounds <= 16):
+            raise ValueError(
+                "max_delay_rounds must be in [0, 16] (SPEC §A.2: the "
+                "delayed-open check is a D-deep static loop per edge; "
+                "0 = off)")
+        if self.attack not in ("none", "elect", "sticky"):
+            raise ValueError(f"unknown attack {self.attack!r} (SPEC §A.3: "
+                             "none | elect | sticky)")
+        if self.attack != "none":
+            if self.protocol != "raft":
+                raise ValueError(
+                    "attack != 'none' is a SPEC §A.3 Raft-targeted "
+                    f"adversary; {self.protocol} would silently ignore it")
+            if self.engine == "cpu":
+                raise ValueError(
+                    "attack != 'none' is a tpu-engine adversary (SPEC "
+                    "§A.3); the C++ oracle does not implement it and "
+                    "would silently simulate different trajectories")
+            if self.attack == "elect" and self.attack_target != 0:
+                raise ValueError(
+                    "attack_target is read only by attack='sticky' (SPEC "
+                    "§A.3 leader-stickiness); 'elect' jams election "
+                    "traffic population-wide and would silently ignore it")
+            if not (0 <= self.attack_target < self.n_nodes):
+                raise ValueError("attack_target must be in [0, n_nodes)")
+        else:
+            if self.attack_rate != 1.0 or self.attack_target != 0:
+                raise ValueError(
+                    "attack_rate/attack_target require attack != 'none' "
+                    "(SPEC §A.3) — they would be silently ignored")
         if self.t_max <= self.t_min:
             raise ValueError("t_max must exceed t_min")
         if self.max_active < 0:
@@ -189,6 +243,14 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     def recover_cutoff(self) -> int:
         return prob_threshold_u32(self.recover_prob)
 
+    @property
+    def miss_cutoff(self) -> int:
+        return prob_threshold_u32(self.miss_rate)
+
+    @property
+    def attack_cutoff(self) -> int:
+        return prob_threshold_u32(self.attack_rate)
+
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
         d["mesh_shape"] = list(self.mesh_shape)
@@ -198,6 +260,8 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             "churn": self.churn_cutoff,
             "crash": self.crash_cutoff,
             "recover": self.recover_cutoff,
+            "miss": self.miss_cutoff,
+            "attack": self.attack_cutoff,
         }
         return json.dumps(d, indent=2)
 

@@ -52,9 +52,14 @@ STREAM_VOTE = np.uint32(0xD3A2646C)     # per (epoch, validator) vote target
 STREAM_VALUE = np.uint32(0xFD7046C5)    # proposal payload values
 STREAM_BYZANTINE = np.uint32(0xB55A4F09)  # reserved: byzantine node pick
 STREAM_EQUIV = np.uint32(0x94D049BB)    # per (round, byz sender, receiver) stance
-# SPEC §6c crash-recover adversary. TPU-engine only (not mirrored in
-# cpp/oracle.cpp; Config rejects crash_prob > 0 on the cpu engine).
+# SPEC §6c crash-recover adversary (mirrored scalar-for-scalar in
+# cpp/oracle.cpp since the adversary-library PR — adversarial configs
+# stay byte-differential against the oracle).
 STREAM_CRASH = np.uint32(0x68E31DA5)    # per (round, node) crash/recover draw
+# SPEC Appendix A adversary library.
+STREAM_SLOTMISS = np.uint32(0x7F4A7C15)  # per (round, producer) DPoS slot miss
+STREAM_DELAY = np.uint32(0x2545F491)     # per (origin round, d, edge) retransmit
+STREAM_ATTACK = np.uint32(0xBB67AE85)    # per round targeted-attack activation
 
 # --- machine-checked stream registry (tools/lint, check `streams`) ---------
 #
@@ -78,18 +83,24 @@ STREAM_KEYS = {
     "STREAM_BYZANTINE": ("reserved", "reserved", "reserved"),
     "STREAM_EQUIV": ("round", "sender", "receiver"),
     "STREAM_CRASH": ("round", "subdraw", "node"),      # c0: 0=crash 1=recover
+    "STREAM_SLOTMISS": ("round", "subdraw", "producer"),  # c0: 0 (reserved)
+    "STREAM_DELAY": ("origin_round", "delay", "edge"),  # via the §A.2 mixer
+    "STREAM_ATTACK": ("round", None, None),
 }
 
 # Streams the C++ oracle deliberately does NOT mirror (cpp/threefry.h):
-# SPEC §6c is TPU-engine-only — Config rejects crash_prob > 0 on the
-# cpu engine rather than silently simulating different trajectories.
-STREAM_TPU_ONLY = frozenset({"STREAM_CRASH"})
+# the SPEC §A.3 targeted Raft attacks are TPU-engine-only — Config
+# rejects attack != "none" on the cpu engine rather than silently
+# simulating different trajectories. (§6c STREAM_CRASH *is* mirrored
+# since the adversary-library PR.)
+STREAM_TPU_ONLY = frozenset({"STREAM_ATTACK"})
 
-# Streams drawn through the SPEC §2 murmur-style mixer (delivery_u32_*),
-# never through the threefry entry points — the two generators share a
-# key constant but not counter space, so a threefry draw keyed on a
-# mixer stream would be a new, unregistered stream in disguise.
-STREAM_MIXER_ONLY = frozenset({"STREAM_DELIVER"})
+# Streams drawn through the SPEC §2 murmur-style mixer (delivery_u32_*,
+# delay_u32_*), never through the threefry entry points — the two
+# generators share a key constant but not counter space, so a threefry
+# draw keyed on a mixer stream would be a new, unregistered stream in
+# disguise.
+STREAM_MIXER_ONLY = frozenset({"STREAM_DELIVER", "STREAM_DELAY"})
 
 
 def _rotl32_np(x: np.ndarray, r: int) -> np.ndarray:
@@ -266,6 +277,28 @@ def delivery_u32_jnp(seed, r, i, j):
     """
     k0 = jnp.asarray(seed).astype(jnp.uint32) ^ jnp.uint32(int(STREAM_DELIVER))
     h = mix_absorb_jnp(k0, r)
+    return mix_fin_jnp(mix_absorb_jnp(mix_absorb_jnp(h, i), j))
+
+
+def delay_u32_np(seed, q, d, i, j):
+    """SPEC §A.2 delayed-retransmission draw (numpy): one u32 per
+    (origin round q, delay d, edge i→j), via the same murmur-style
+    mixer as :func:`delivery_u32_np` but keyed on STREAM_DELAY and
+    absorbing FOUR values — (q, d, i, j) — so delayed copies of one
+    flight at different d are independent and never collide with the
+    base delivery stream. Broadcasts over all args."""
+    k0 = ((np.asarray(seed, np.uint64) & np.uint64(0xFFFFFFFF))
+          .astype(np.uint32) ^ STREAM_DELAY)
+    h = mix_absorb_np(mix_absorb_np(k0, q), d)
+    return mix_fin_np(mix_absorb_np(mix_absorb_np(h, i), j))
+
+
+def delay_u32_jnp(seed, q, d, i, j):
+    """Traceable twin of :func:`delay_u32_np`. ``seed`` may be traced;
+    the (seed, q, d) absorbs hoist themselves through broadcasting at
+    edge-mask call sites (scalars per round and per d)."""
+    k0 = jnp.asarray(seed).astype(jnp.uint32) ^ jnp.uint32(int(STREAM_DELAY))
+    h = mix_absorb_jnp(mix_absorb_jnp(k0, q), d)
     return mix_fin_jnp(mix_absorb_jnp(mix_absorb_jnp(h, i), j))
 
 

@@ -71,18 +71,24 @@ def dpos_schedule(cfg: Config, seed):
 
 
 def _producer_delivery(cfg: Config, seed, r, p):
-    """Delivery row deliver(p, v) for the single producer p (SPEC §2)."""
+    """Delivery row deliver(p, v) for the single producer p (SPEC §2;
+    §A.2 delayed retransmission on the same absolute edge keys when
+    ``max_delay_rounds > 0``)."""
     V = cfg.n_nodes
     v_idx = jnp.arange(V, dtype=jnp.uint32)
     ur = jnp.asarray(r, jnp.uint32)
     up = jnp.asarray(p, jnp.uint32)
-    dropped = (rng.delivery_u32_jnp(seed, ur, up, v_idx)
-               < _lt(cfg.drop_cutoff))
+    open_drop = ~(rng.delivery_u32_jnp(seed, ur, up, v_idx)
+                  < _lt(cfg.drop_cutoff))
+    if cfg.max_delay_rounds > 0:
+        from ..ops.adversary import delayed_open
+        open_drop |= delayed_open(seed, ur, up, v_idx, cfg.drop_cutoff,
+                                  cfg.max_delay_rounds)
     part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
                    < _lt(cfg.partition_cutoff))
     side = _draw(seed, rng.STREAM_PARTITION, ur, 1, v_idx) & jnp.uint32(1)
     side_p = _draw(seed, rng.STREAM_PARTITION, ur, 1, up) & jnp.uint32(1)
-    ok = (~dropped) & ((side == side_p) | ~part_active)
+    ok = open_drop & ((side == side_p) | ~part_active)
     return ok & (v_idx != up)  # self handled separately
 
 
@@ -94,6 +100,7 @@ DPOS_TELEMETRY = ("blocks_appended",     # validator-chain extensions
                   "missed_appends",      # validators not extended
                   "producer_rotations",  # slot handoffs p_{r-1} != p_r
                   "churn_slots",         # rounds churned (no block)
+                  "missed_slots",        # SPEC §A.1 per-producer slot miss
                   ) + CRASH_TELEMETRY    # SPEC §6c (zeros when disabled)
 
 # Flight-recorder latency histogram (docs/OBSERVABILITY.md §"Flight
@@ -128,9 +135,20 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
             seed, jnp.asarray(r, jnp.uint32), down, cfg.crash_cutoff,
             cfg.recover_cutoff, cfg.max_crashed)
 
+    # SPEC §A.1 per-producer slot miss: round r's slot is skipped
+    # chain-wide (like churn), but the draw is keyed (round, producer)
+    # so failures correlate with the schedule. miss_cutoff == 0 is a
+    # static no-op — the round program is byte-identical.
+    miss_on = cfg.miss_cutoff > 0
+    if miss_on:
+        from ..ops.adversary import slot_missed
+        miss = slot_missed(seed, r, p, cfg.miss_cutoff)
+
     recv = _producer_delivery(cfg, seed, r, p)
     recv = recv | (jnp.arange(V, dtype=jnp.int32) == p)   # self-append
     append = recv & ~churn & (st.chain_len < L)
+    if miss_on:
+        append = append & ~miss
     if crash_on:
         append = append & ~down & ~down[p]
 
@@ -148,9 +166,10 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
                        (rp % cfg.epoch_len) % cfg.n_producers]
     n_app = jnp.sum(append.astype(jnp.int32))
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    missed = miss.astype(jnp.int32) if miss_on else jnp.int32(0)
     vec = jnp.stack([n_app, jnp.int32(V) - n_app,
                      ((r > 0) & (p != p_prev)).astype(jnp.int32),
-                     churn.astype(jnp.int32), *cz])
+                     churn.astype(jnp.int32), missed, *cz])
     if not flight:
         return new, vec
     from ..ops.flight import bucket_counts

@@ -98,7 +98,8 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False,
     idx = jnp.arange(N, dtype=jnp.int32)
     eye = jnp.eye(N, dtype=bool)
 
-    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff,
+                        cfg.max_delay_rounds)
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
 
     # SPEC §6c crash-recover adversary. Volatile on recovery: promised[]

@@ -150,7 +150,8 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     idx = jnp.arange(N, dtype=jnp.int32)
     sarange = jnp.arange(S, dtype=jnp.int32)
 
-    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff,
+                        cfg.max_delay_rounds)
     # SPEC §6c crash-recover adversary: down nodes neither send nor
     # receive; static no-op when crash_cutoff == 0 (digest-neutral).
     crash_on = cfg.crash_cutoff > 0

@@ -381,6 +381,12 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     # The general path is untouched.
     no_part = cfg.partition_cutoff == 0
     bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
+    if cfg.max_delay_rounds > 0:
+        # SPEC §A.2 delayed retransmission on the per-sender broadcast
+        # key (i, i) — the §6b analog of the edge-wise delay term.
+        from ..ops.adversary import delayed_open
+        bcast = bcast | delayed_open(seed, ur, uidx, uidx, cfg.drop_cutoff,
+                                     cfg.max_delay_rounds)
     # SPEC §6c crash-recover adversary: a down node's round broadcasts
     # drop atomically (folded into the per-sender bcast flag — exactly
     # the §6b fault granularity); the receiving side is handled by

@@ -72,7 +72,8 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     sarange = jnp.arange(S, dtype=jnp.int32)
     real = idx < n_real
 
-    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff,
+                        cfg.max_delay_rounds)
     deliver = deliver & real[:, None] & real[None, :]
     churn = churn_draw(seed, ur, cfg.churn_cutoff)
     honest = idx < (n_real - cfg.n_byzantine)
@@ -213,8 +214,14 @@ def pbft_bcast_round_padded(cfg: Config, st: PbftState, r, n_real, f,
     real = idx < n_real
 
     no_part = cfg.partition_cutoff == 0
-    bcast = (rng.delivery_u32_jnp(seed, ur, uidx, uidx)
-             >= _lt(cfg.drop_cutoff)) & real
+    bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
+    if cfg.max_delay_rounds > 0:
+        # SPEC §A.2 on the §6b broadcast key — same absolute (i, i)
+        # keys as the unpadded engine, so padding stays byte-invisible.
+        from ..ops.adversary import delayed_open
+        bcast = bcast | delayed_open(seed, ur, uidx, uidx, cfg.drop_cutoff,
+                                     cfg.max_delay_rounds)
+    bcast = bcast & real
     if not no_part:
         part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
                        < _lt(cfg.partition_cutoff))

@@ -189,6 +189,7 @@ RAFT_TELEMETRY = ("leader_elections",    # candidates winning this round
                   "append_accepted",     # AppendEntries applied (log match)
                   "append_rejected",     # AppendEntries refused (mismatch)
                   "entries_committed",   # Σ per-node commit-index advance
+                  "attack_rounds",       # SPEC §A.3 attack-active rounds
                   ) + CRASH_TELEMETRY    # SPEC §6c (zeros when disabled)
 
 # Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
@@ -226,8 +227,28 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
     ur = jnp.asarray(r, jnp.uint32)
     eye = jnp.eye(N, dtype=bool)
 
-    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff)
+    deliver = _delivery(seed, N, ur, cfg.drop_cutoff, cfg.partition_cutoff,
+                        cfg.max_delay_rounds)
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+
+    # SPEC §A.3 targeted attacks. attack == "none" is a static config
+    # fact — no draw, no masks, byte-identical round program.
+    elect_on = cfg.attack == "elect"
+    sticky_on = cfg.attack == "sticky"
+    if elect_on or sticky_on:
+        from ..ops.adversary import attack_fires
+        atk = attack_fires(seed, ur, cfg.attack_cutoff)
+    if sticky_on:
+        # Leader-stickiness abuse: while the target holds the
+        # leadership at the START of an attacked round, ALL inbound
+        # delivery to it is jammed (it never observes higher terms, so
+        # the §3 term-change rule cannot fire) and the P0 churn
+        # step-down skips it. Its own broadcasts still travel.
+        tgt = cfg.attack_target
+        sticky_act = atk & (st.role[tgt] == ROLE_L)
+        deliver = deliver & ~(sticky_act
+                              & (jnp.arange(N, dtype=jnp.int32)[None, :]
+                                 == tgt))
     # SPEC §3c Raft byzantine minority (ids >= N - n_byzantine):
     # "silent" withholds every send (votes, acks, heartbeats); state
     # updates stay normal. "equivocate" double-grants: a byz node's vote
@@ -277,6 +298,8 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
 
     # ---- P0 churn.
     stepdown = churn & (role == ROLE_L)
+    if sticky_on:
+        stepdown = stepdown & ~(sticky_act & (idx == tgt))
     role = jnp.where(stepdown, ROLE_F, role)
     timer = jnp.where(stepdown, 0, timer)
     reset = stepdown
@@ -291,6 +314,21 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
     timeout = jnp.where(cand_new, _draw_timeout(seed, cfg.t_min, cfg.t_max, term, uidx),
                         timeout)
 
+    # SPEC §A.3 "elect": repeated election disruption — in any attacked
+    # round where a candidacy fired in P1 (a timeout expired, so a
+    # quorum is about to assemble), ALL round-r election traffic is
+    # jammed: P2a/P2b/P2c see no delivered requests or responses. P3
+    # replication traffic is untouched. Only LIVE candidacies count
+    # under §6c: a down node's cand_new is a phantom (its frozen timer
+    # stays expired for the whole outage, and the freeze reverts the
+    # candidacy itself), so it must not keep the jammer firing.
+    if elect_on:
+        live_cand = cand_new & up if crash_on else cand_new
+        jam = atk & jnp.any(live_cand)
+        deliver_e = deliver & ~jam
+    else:
+        deliver_e = deliver
+
     # ---- P2 election. Requests snapshot (post-P1).
     was_cand = role == ROLE_C
     if withhold:
@@ -299,7 +337,8 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
     req_lterm = _last_term(log_term, log_len)
 
     # P2a term catch-up: max delivered candidate term per receiver j.
-    sent_term = jnp.where((was_cand[:, None]) & deliver, req_term[:, None], 0)
+    sent_term = jnp.where((was_cand[:, None]) & deliver_e,
+                          req_term[:, None], 0)
     t_in = jnp.max(sent_term, axis=0)
     bumped = t_in > term
     term, role, voted_for, timeout = bump(bumped, t_in, term, role, voted_for, timeout)
@@ -309,7 +348,8 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
     up_to_date = (req_lterm[:, None] > own_lterm[None, :]) | (
         (req_lterm[:, None] == own_lterm[None, :])
         & (req_lidx[:, None] >= log_len[None, :]))
-    elig = was_cand[:, None] & deliver & (req_term[:, None] == term[None, :]) & up_to_date
+    elig = was_cand[:, None] & deliver_e \
+        & (req_term[:, None] == term[None, :]) & up_to_date
     vf_safe = jnp.clip(voted_for, 0, N - 1)
     vf_elig = (voted_for >= 0) & (_pick_row(elig, vf_safe) > 0)
     first_elig = jnp.min(jnp.where(elig, idx[:, None], N), axis=0)
@@ -322,12 +362,13 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
     reset |= granted
 
     # P2c tally: votes[c] = 1 + Σ_j [grant_j == c ∧ delivered(j, c)].
-    resp = (grant[:, None] == idx[None, :]) & deliver
+    resp = (grant[:, None] == idx[None, :]) & deliver_e
     if withhold:
         resp &= honest[:, None]  # byz vote responses never travel
     if double_grant:
         # Byz j's response reaches EVERY candidate whose request it got.
-        byz_votes = (~honest)[:, None] & was_cand[None, :] & deliver.T & deliver
+        byz_votes = (~honest)[:, None] & was_cand[None, :] \
+            & deliver_e.T & deliver_e
         resp = jnp.where((~honest)[:, None], byz_votes, resp)
     votes = 1 + jnp.sum(resp, axis=0, dtype=jnp.int32)
     win = (role == ROLE_C) & (votes >= majority)
@@ -460,10 +501,16 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
     if not telem:
         return new
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    if elect_on:
+        attacked = jam.astype(jnp.int32)
+    elif sticky_on:
+        attacked = sticky_act.astype(jnp.int32)
+    else:
+        attacked = jnp.int32(0)
     vec = jnp.stack([jnp.sum(win.astype(jnp.int32)),
                      jnp.sum(apply_.astype(jnp.int32)),
                      jnp.sum(append_rej.astype(jnp.int32)),
-                     jnp.sum(commit - st.commit), *cz])
+                     jnp.sum(commit - st.commit), attacked, *cz])
     if not flight:
         return new, vec
     from ..ops.flight import bucket_counts

@@ -173,12 +173,29 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
 
     crash_on = cfg.crash_cutoff > 0
 
+    # SPEC §A.3 targeted attacks — same semantics as the dense kernel
+    # (attack == "none" is a static no-op). The sticky mask is defined
+    # on the START-of-round role; the elect jam (defined after P1, when
+    # cand_new exists) masks only the P2 election edges at their call
+    # sites.
+    elect_on = cfg.attack == "elect"
+    sticky_on = cfg.attack == "sticky"
+    if elect_on or sticky_on:
+        from ..ops.adversary import attack_fires
+        atk = attack_fires(seed, ur, cfg.attack_cutoff)
+    if sticky_on:
+        tgt = cfg.attack_target
+        sticky_act = atk & (st.role[tgt] == ROLE_L)
+
     def dedge(src, dst):
-        m = _edges(seed, ur, src, dst, cfg.drop_cutoff, cfg.partition_cutoff)
+        m = _edges(seed, ur, src, dst, cfg.drop_cutoff, cfg.partition_cutoff,
+                   cfg.max_delay_rounds)
         if crash_on:  # SPEC §6c: down nodes neither send nor receive
             s = jnp.clip(jnp.asarray(src, jnp.int32), 0, N - 1)
             d = jnp.clip(jnp.asarray(dst, jnp.int32), 0, N - 1)
             m = m & up[s] & up[d]
+        if sticky_on:  # SPEC §A.3: inbound to the sticky leader jammed
+            m = m & ~(sticky_act & (jnp.asarray(dst, jnp.int32) == tgt))
         return m
 
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
@@ -217,6 +234,8 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
 
     # ---- P0 churn.
     stepdown = churn & (role == ROLE_L)
+    if sticky_on:
+        stepdown = stepdown & ~(sticky_act & (idx == tgt))
     role = jnp.where(stepdown, ROLE_F, role)
     timer = jnp.where(stepdown, 0, timer)
     reset = stepdown
@@ -245,6 +264,15 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     req_lidx = log_len[cid]
     req_lterm = _last_term(log_term[cid], log_len[cid])
     del_cj = dedge(cand_ids[:, None], idx[None, :])            # [A, N]
+    if elect_on:
+        # SPEC §A.3 "elect": jam ALL round-r election traffic in any
+        # attacked round where a candidacy fired in P1. Only LIVE
+        # candidacies count under §6c — a down node's frozen expired
+        # timer re-fires cand_new every round, but the freeze reverts
+        # the candidacy, so it must not keep the jammer firing.
+        live_cand = cand_new & up if crash_on else cand_new
+        jam = atk & jnp.any(live_cand)
+        del_cj = del_cj & ~jam
 
     # P2a term catch-up.
     t_in = jnp.max(jnp.where(del_cj, req_term[:, None], 0), axis=0)
@@ -271,6 +299,8 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
 
     # P2c tally per active candidate; winners become leaders.
     del_jc = dedge(idx[:, None], cand_ids[None, :])            # [N, A]
+    if elect_on:
+        del_jc = del_jc & ~jam
     resp = (grant[:, None] == cand_ids[None, :]) & del_jc
     if withhold:
         resp &= honest[:, None]
@@ -439,10 +469,16 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     if not telem:
         return new
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    if elect_on:
+        attacked = jam.astype(jnp.int32)
+    elif sticky_on:
+        attacked = sticky_act.astype(jnp.int32)
+    else:
+        attacked = jnp.int32(0)
     vec = jnp.stack([jnp.sum(win.astype(jnp.int32)),
                      jnp.sum(apply_.astype(jnp.int32)),
                      jnp.sum(append_rej.astype(jnp.int32)),
-                     jnp.sum(commit - st.commit), *cz])
+                     jnp.sum(commit - st.commit), attacked, *cz])
     if not flight:
         return new, vec
     lat = jnp.stack([bucket_counts(st.timer[cid] + 1, win),

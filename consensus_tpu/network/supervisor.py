@@ -169,11 +169,19 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     if fallback_cpu and cfg.engine != "tpu":
         raise ValueError("fallback_cpu degrades the tpu engine to the CPU "
                          f"oracle; cfg.engine={cfg.engine!r} already is it")
-    if fallback_cpu and cfg.crash_prob > 0:
+    if fallback_cpu and cfg.attack != "none":
+        # Die HERE, at supervision setup — not via Config's engine="cpu"
+        # rejection three retries later, mid-degradation. Trajectory-
+        # changing TPU-only adversaries cannot degrade (unlike the
+        # digest-neutral flight recorder, which the fallback simply
+        # drops); §6c crash/§A.1 slot-miss/§A.2 delay CAN — they are
+        # mirrored scalar-for-scalar in the oracle since the
+        # adversary-library PR.
         raise ValueError(
-            "fallback_cpu cannot honor crash_prob > 0: the crash-recover "
-            "adversary (SPEC §6c) is not implemented by the CPU oracle, so "
-            "the degraded run would simulate different trajectories")
+            "fallback_cpu cannot honor attack != 'none': the SPEC §A.3 "
+            "targeted Raft attacks are not implemented by the CPU oracle, "
+            "so the degraded run would simulate different trajectories — "
+            "drop --fallback-cpu or the attack")
     if fallback_cpu and seeds is not None:
         raise ValueError(
             "fallback_cpu cannot honor an explicit seeds vector: the CPU "

@@ -47,11 +47,12 @@ COMMIT_COUNTERS = {
     "dpos": ("blocks_appended",),
 }
 # Counters whose first nonzero window marks FAULT ONSET for the
-# recovery-time metric: the §6c crash adversary plus the protocol's own
-# disruption signals (elections / view changes are what an availability
-# attack looks like from inside the protocol).
-FAULT_COUNTERS = ("crashes", "nodes_down", "leader_elections",
-                  "view_changes")
+# recovery-time metric: the §6c crash adversary, the SPEC Appendix A
+# attack counters (per-producer slot misses, targeted-attack rounds),
+# plus the protocol's own disruption signals (elections / view changes
+# are what an availability attack looks like from inside the protocol).
+FAULT_COUNTERS = ("crashes", "nodes_down", "missed_slots", "attack_rounds",
+                  "leader_elections", "view_changes")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -35,7 +35,31 @@ def bitcast_i32(x):
     return jax.lax.bitcast_convert_type(x, jnp.int32)
 
 
-def delivery(seed, N: int, r, drop_cut: int, part_cut: int):
+def delayed_open(seed, r, i, j, drop_cut: int, max_delay: int):
+    """SPEC §A.2: does a flight dropped on edge i→j at some round
+    q ∈ [r − max_delay, r) arrive at r via a successful retransmission?
+
+    Pure function of (seed, r, edge) — no queue rides the carry. For
+    each static delay d: the base delivery draw at q = r − d must have
+    DROPPED (draw < drop_cut) and the delay-mixer re-draw must survive
+    the same cutoff (the retransmitted copy is itself subject to drop).
+    The ``r >= d`` guard keeps uint32 round keys from wrapping in the
+    first ``max_delay`` rounds. Scalar twin: cpp/threefry.h
+    ``delayed_open``."""
+    ur = jnp.asarray(r, jnp.uint32)
+    open_ = None
+    for d in range(1, max_delay + 1):
+        q = ur - jnp.uint32(d)
+        hit = ((ur >= jnp.uint32(d))
+               & (rng.delivery_u32_jnp(seed, q, i, j) < cutoff(drop_cut))
+               & (rng.delay_u32_jnp(seed, q, jnp.uint32(d), i, j)
+                  >= cutoff(drop_cut)))
+        open_ = hit if open_ is None else (open_ | hit)
+    return open_
+
+
+def delivery(seed, N: int, r, drop_cut: int, part_cut: int,
+             max_delay: int = 0):
     """SPEC §2: [i, j] True iff a message i→j is delivered in round r.
 
     Composition of per-edge drops, an optional per-round bipartition
@@ -43,17 +67,22 @@ def delivery(seed, N: int, r, drop_cut: int, part_cut: int):
     drop draw is the SPEC §2 murmur-style mixer (see core.rng delivery
     mixer notes); the absorb chain hoists itself through broadcasting —
     (seed, r) is a scalar, the i-absorb is [N, 1] — so only the
-    j-absorb + finalizer touch all N^2 edges.
+    j-absorb + finalizer touch all N^2 edges. ``max_delay > 0`` adds
+    the SPEC §A.2 delayed-retransmission term to the drop leg
+    (partitions are topology faults — never repaired by retransmission);
+    0 compiles to the byte-identical §2 program.
     """
     i = jnp.arange(N, dtype=jnp.uint32)[:, None]
     j = jnp.arange(N, dtype=jnp.uint32)[None, :]
-    dropped = rng.delivery_u32_jnp(seed, r, i, j) < cutoff(drop_cut)
+    open_drop = ~(rng.delivery_u32_jnp(seed, r, i, j) < cutoff(drop_cut))
+    if max_delay > 0:
+        open_drop |= delayed_open(seed, r, i, j, drop_cut, max_delay)
     part_active = draw(seed, rng.STREAM_PARTITION, r, 0, 0) < cutoff(part_cut)
     side = (draw(seed, rng.STREAM_PARTITION, r, 1, jnp.arange(N, dtype=jnp.uint32))
             & jnp.uint32(1))
     same_side = side[:, None] == side[None, :]
     off_diag = i != j
-    return (~dropped) & (same_side | ~part_active) & off_diag
+    return open_drop & (same_side | ~part_active) & off_diag
 
 
 def churn(seed, r, churn_cut: int):
@@ -122,7 +151,8 @@ def crash_counts(crashed=None, rec=None, down=None):
             jnp.sum(down.astype(jnp.int32)))
 
 
-def delivery_edges(seed, r, src, dst, drop_cut: int, part_cut: int):
+def delivery_edges(seed, r, src, dst, drop_cut: int, part_cut: int,
+                   max_delay: int = 0):
     """SPEC §2 delivery evaluated on explicit (src, dst) edge id arrays.
 
     Broadcasts ``src`` against ``dst`` (e.g. src [A, 1] x dst [1, N]) and
@@ -131,14 +161,35 @@ def delivery_edges(seed, r, src, dst, drop_cut: int, part_cut: int):
     entries, so evaluating only live edges (the large-N engines' O(A*N)
     path, SURVEY.md §7 "never materialize full N^2") is byte-invisible.
     Negative ids are allowed (masked-out lanes) and return False.
+    ``max_delay`` adds the SPEC §A.2 delayed-retransmission term exactly
+    as :func:`delivery` does (same absolute keys — byte-invisible).
     """
     valid = (src >= 0) & (dst >= 0)
     usrc = jnp.asarray(src, jnp.int32).astype(jnp.uint32)
     udst = jnp.asarray(dst, jnp.int32).astype(jnp.uint32)
-    dropped = rng.delivery_u32_jnp(seed, r, usrc, udst) < cutoff(drop_cut)
+    open_drop = ~(rng.delivery_u32_jnp(seed, r, usrc, udst)
+                  < cutoff(drop_cut))
+    if max_delay > 0:
+        open_drop |= delayed_open(seed, r, usrc, udst, drop_cut, max_delay)
     part_active = draw(seed, rng.STREAM_PARTITION, r, 0, 0) < cutoff(part_cut)
     side_s = draw(seed, rng.STREAM_PARTITION, r, 1, usrc) & jnp.uint32(1)
     side_d = draw(seed, rng.STREAM_PARTITION, r, 1, udst) & jnp.uint32(1)
     same_side = side_s == side_d
     off_diag = usrc != udst
-    return valid & (~dropped) & (same_side | ~part_active) & off_diag
+    return valid & open_drop & (same_side | ~part_active) & off_diag
+
+
+def slot_missed(seed, r, p, miss_cut: int):
+    """SPEC §A.1: does round r's scheduled producer ``p`` miss its slot?
+    One threefry draw per (round, producer) — the per-producer keying is
+    the point: failures correlate with the schedule, so an unlucky
+    producer vanishes from the distinct-producer suffix and LIB stalls."""
+    return draw(seed, rng.STREAM_SLOTMISS, jnp.asarray(r, jnp.uint32), 0,
+                jnp.asarray(p, jnp.int32).astype(jnp.uint32)) \
+        < cutoff(miss_cut)
+
+
+def attack_fires(seed, r, attack_cut: int):
+    """SPEC §A.3: the per-round targeted-attack activation draw."""
+    return draw(seed, rng.STREAM_ATTACK, jnp.asarray(r, jnp.uint32), 0, 0) \
+        < cutoff(attack_cut)

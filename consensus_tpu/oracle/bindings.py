@@ -58,15 +58,15 @@ def get_lib() -> ctypes.CDLL:
     lib.ctpu_delivery_u32.restype = u32
     lib.ctpu_delivery_u32.argtypes = [u64, u32, u32, u32]
     lib.ctpu_raft_run.restype = ctypes.c_int
-    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 13 + [p32] * 5
+    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 17 + [p32] * 5
     p8 = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
     lib.ctpu_paxos_run.restype = ctypes.c_int
-    lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 8 + [p32, p8, p32, p32, p32]
+    lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 12 + [p32, p8, p32, p32, p32]
     lib.ctpu_pbft_run.restype = ctypes.c_int
-    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 12 + [p8, p32, p32]
+    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 16 + [p8, p32, p32]
     pi32 = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
     lib.ctpu_dpos_run.restype = ctypes.c_int
-    lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 9 + [p32] * 3 + [pi32]
+    lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 14 + [p32] * 3 + [pi32]
     _lib = lib
     return lib
 
@@ -98,6 +98,8 @@ def raft_run(cfg, sweep: int = 0, delivery: str = "auto"):
         cfg.max_active,
         cfg.n_byzantine, 1 if cfg.byz_mode == "equivocate" else 0,
         _delivery_code(delivery),
+        cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
+        cfg.max_delay_rounds,
         out["commit"], out["log_term"].reshape(-1), out["log_val"].reshape(-1),
         out["term"], out["role"])
     if rc != 0:
@@ -121,6 +123,8 @@ def paxos_run(cfg, sweep: int = 0, delivery: str = "auto"):
         seed, N, cfg.n_rounds, S, cfg.n_proposers,
         cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
         _delivery_code(delivery),
+        cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
+        cfg.max_delay_rounds,
         out["learned_val"].reshape(-1), out["learned_mask"].reshape(-1),
         out["promised"].reshape(-1), out["acc_bal"].reshape(-1),
         out["acc_val"].reshape(-1))
@@ -145,6 +149,8 @@ def pbft_run(cfg, sweep: int = 0, delivery: str = "auto"):
         1 if cfg.fault_model == "bcast" else 0,
         cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
         _delivery_code(delivery),
+        cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
+        cfg.max_delay_rounds,
         out["committed"].reshape(-1), out["dval"].reshape(-1), out["view"])
     if rc != 0:
         raise RuntimeError(f"oracle pbft_run failed rc={rc}")
@@ -165,6 +171,8 @@ def dpos_run(cfg, sweep: int = 0):
     rc = lib.ctpu_dpos_run(
         seed, V, cfg.n_rounds, L, cfg.n_candidates, cfg.n_producers,
         cfg.epoch_len, cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
+        cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
+        cfg.miss_cutoff, cfg.max_delay_rounds,
         out["chain_r"].reshape(-1), out["chain_p"].reshape(-1),
         out["chain_len"], out["lib"])
     if rc != 0:

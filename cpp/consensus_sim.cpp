@@ -40,6 +40,12 @@ struct Args {
   uint32_t t_min = 3, t_max = 8;
   uint32_t max_active = 0;  // raft: 0 = dense, >0 = SPEC §3b active cap
   double drop_rate = 0.0, partition_rate = 0.0, churn_rate = 0.0;
+  // SPEC §6c crash-recover adversary (mirrored in oracle.cpp).
+  double crash_prob = 0.0, recover_prob = 0.0;
+  uint32_t max_crashed = 0;
+  // SPEC §A.1 per-producer DPoS slot faults / §A.2 bounded delay.
+  double miss_rate = 0.0;
+  uint32_t max_delay_rounds = 0;
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
   std::string byz_mode = "silent";
   std::string fault_model = "edge";  // "edge" (SPEC §2) | "bcast" (§6b, pbft)
@@ -50,6 +56,12 @@ struct Args {
   uint32_t n_proposers = 0;
   uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;
   std::string out_path;  // optional: dump raw payload bytes
+  // SPEC Appendix A scripted scenario name. Scenario runs pair the
+  // attack config with flight-recorder timeline assertions, which only
+  // the TPU engine records — `--engine tpu --scenario NAME` re-execs
+  // the Python front door before strict parsing; a cpu-engine scenario
+  // is rejected below rather than silently ignored.
+  std::string scenario;
   bool nodes_given = false;
 };
 
@@ -71,11 +83,15 @@ uint32_t prob_threshold_u32(double p) {
       "  [--log-capacity L] [--max-entries E] [--t-min T] [--t-max T]\n"
       "  [--max-active A]   (raft: 0 = dense, >0 = SPEC 3b active cap)\n"
       "  [--drop-rate P] [--partition-rate P] [--churn-rate P]\n"
+      "  [--crash-prob P] [--recover-prob P] [--max-crashed K]  (SPEC 6c)\n"
+      "  [--miss-rate P]           (SPEC A.1 per-producer slot miss; dpos)\n"
+      "  [--max-delay-rounds D]    (SPEC A.2 bounded delay, D <= 16)\n"
       "  [--f F] [--view-timeout T] [--n-byzantine K]\n"
       "  [--byz-mode silent|equivocate] [--fault-model edge|bcast]\n"
       "  [--oracle-delivery auto|dense|edge]  (cpu engine; digests equal)\n"
       "  [--n-proposers P]\n"
-      "  [--candidates C] [--producers K] [--epoch-len E] [--out FILE]\n",
+      "  [--candidates C] [--producers K] [--epoch-len E] [--out FILE]\n"
+      "  [--scenario NAME]   (scripted attack + timeline assertions; tpu)\n",
       argv0);
   std::exit(code);
 }
@@ -105,6 +121,11 @@ Args parse(int argc, char** argv) {
     else if (k == "--drop-rate") a.drop_rate = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--partition-rate") a.partition_rate = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--churn-rate") a.churn_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--crash-prob") a.crash_prob = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--recover-prob") a.recover_prob = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--max-crashed") a.max_crashed = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--miss-rate") a.miss_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--max-delay-rounds") a.max_delay_rounds = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--f") a.f = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--view-timeout") a.view_timeout = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--n-byzantine") a.n_byzantine = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
@@ -116,6 +137,7 @@ Args parse(int argc, char** argv) {
     else if (k == "--producers") a.n_producers = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--epoch-len") a.epoch_len = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--out") a.out_path = need(k.c_str());
+    else if (k == "--scenario") a.scenario = need(k.c_str());
     else if (k == "--help" || k == "-h") usage(argv[0], 0);
     else { std::fprintf(stderr, "unknown flag %s\n", k.c_str()); usage(argv[0], 2); }
   }
@@ -138,6 +160,26 @@ Args parse(int argc, char** argv) {
       a.oracle_delivery != "edge") {
     std::fprintf(stderr, "unknown --oracle-delivery %s\n",
                  a.oracle_delivery.c_str());
+    std::exit(2);
+  }
+  if (!a.scenario.empty()) {
+    std::fprintf(stderr,
+                 "--scenario pairs a scripted attack config with "
+                 "flight-recorder timeline assertions, which only the TPU "
+                 "engine records — run with --engine tpu (this front door "
+                 "re-execs the Python CLI for it)\n");
+    std::exit(2);
+  }
+  if (a.miss_rate > 0 && a.protocol != "dpos") {
+    std::fprintf(stderr,
+                 "--miss-rate (SPEC A.1) is a per-producer DPoS slot-fault "
+                 "adversary; %s has no producer schedule and would silently "
+                 "ignore it\n", a.protocol.c_str());
+    std::exit(2);
+  }
+  if (a.max_delay_rounds > 16) {
+    std::fprintf(stderr,
+                 "--max-delay-rounds must be in [0, 16] (SPEC A.2)\n");
     std::exit(2);
   }
   if (a.oracle_delivery != "auto" && a.protocol == "dpos") {
@@ -201,6 +243,11 @@ int run_cpu(const Args& a) {
   cfg.drop_cut = prob_threshold_u32(a.drop_rate);
   cfg.part_cut = prob_threshold_u32(a.partition_rate);
   cfg.churn_cut = prob_threshold_u32(a.churn_rate);
+  cfg.crash_cut = prob_threshold_u32(a.crash_prob);
+  cfg.recover_cut = prob_threshold_u32(a.recover_prob);
+  cfg.max_crashed = a.max_crashed;
+  cfg.miss_cut = prob_threshold_u32(a.miss_rate);
+  cfg.max_delay = a.max_delay_rounds;
   cfg.f = a.f;
   cfg.view_timeout = a.view_timeout;
   cfg.n_byzantine = a.n_byzantine;

@@ -32,6 +32,17 @@ struct SimConfig {
   uint32_t fault_bcast = 0;     // pbft fault_model == "bcast" (SPEC §6b)
   uint32_t n_proposers = 0;                            // paxos
   uint32_t n_candidates = 16, n_producers = 4, epoch_len = 16;  // dpos
+  // SPEC §6c crash-recover adversary (mirrored scalar-for-scalar since
+  // the adversary-library PR): per round each up node crashes with
+  // crash_cut (capped at max_crashed simultaneously down; 0 = no cap)
+  // and each down node recovers with recover_cut, rejoining from its
+  // persisted state.
+  uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0;
+  // SPEC §A.1 per-producer DPoS slot-fault cutoff (dpos only).
+  uint32_t miss_cut = 0;
+  // SPEC §A.2 bounded message delay: a dropped flight may arrive via a
+  // retransmission d <= max_delay rounds later (threefry.h delayed_open).
+  uint32_t max_delay = 0;
   // Oracle delivery-layer strategy (execution only — decided logs are
   // byte-identical either way, SPEC §2 draws are pure counter functions):
   // 0 = auto (per-engine choice), 1 = dense [N,N] materialization,

@@ -53,17 +53,28 @@ constexpr uint32_t DELIVERY_AUTO = 0, DELIVERY_DENSE = 1, DELIVERY_EDGE = 2;
 struct Net {
   uint32_t n = 0;
   uint32_t drop_cut = 0;
+  uint32_t max_delay = 0;  // SPEC §A.2 retransmission horizon (0 = off)
+  uint64_t seed = 0;
+  uint32_t r = 0;
   bool part_active = false;
   bool edge_mode = false;
+  // SPEC §6c: when non-null, up[i] == 0 (a down node) kills every edge
+  // touching i — down nodes neither send nor receive.
+  const uint8_t* up = nullptr;
   std::vector<uint8_t> side;  // [n]; filled only when part_active
   std::vector<uint32_t> hi;   // [n] edge mode: per-sender hoisted absorb
   std::vector<uint8_t> mat;   // [n*n] dense mode: delivered?
 
-  void begin_round(uint64_t seed, uint32_t n_, uint32_t r, uint32_t drop_cut_,
-                   uint32_t part_cut, bool edge) {
+  void begin_round(uint64_t seed_, uint32_t n_, uint32_t r_,
+                   uint32_t drop_cut_, uint32_t part_cut, bool edge,
+                   uint32_t max_delay_ = 0, const uint8_t* up_ = nullptr) {
     n = n_;
     drop_cut = drop_cut_;
+    max_delay = max_delay_;
+    seed = seed_;
+    r = r_;
     edge_mode = edge;
+    up = up_;
     part_active = random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
     if (part_active) {
       side.resize(n);
@@ -80,19 +91,31 @@ struct Net {
     }
     mat.assign(size_t(n) * n, 0);
     for (uint32_t i = 0; i < n; ++i) {
+      if (up && !up[i]) continue;
       const uint32_t h = mix_absorb(hr, i);
       for (uint32_t j = 0; j < n; ++j) {
         if (i == j) continue;
-        if (mix_fin(mix_absorb(h, j)) < drop_cut) continue;
+        if (up && !up[j]) continue;
+        // SPEC §2 drop leg, repaired by a §A.2 delayed retransmission;
+        // partitions are topology faults — never repaired.
+        bool open = mix_fin(mix_absorb(h, j)) >= drop_cut;
+        if (!open && max_delay)
+          open = delayed_open(seed, r, i, j, drop_cut, max_delay);
+        if (!open) continue;
         if (part_active && side[i] != side[j]) continue;
         mat[size_t(i) * n + j] = 1;
       }
     }
   }
-  // The SPEC §2 edge decision for i → j (drop ∘ partition ∘ no-self).
+  // The SPEC §2 edge decision for i → j (drop ∘ §A.2 delayed
+  // retransmission ∘ partition ∘ §6c down endpoints ∘ no-self).
   bool edge(uint32_t i, uint32_t j) const {
     if (i == j) return false;
-    if (mix_fin(mix_absorb(hi[i], j)) < drop_cut) return false;
+    if (up && (!up[i] || !up[j])) return false;
+    bool open = mix_fin(mix_absorb(hi[i], j)) >= drop_cut;
+    if (!open && max_delay)
+      open = delayed_open(seed, r, i, j, drop_cut, max_delay);
+    if (!open) return false;
     return !part_active || side[i] == side[j];
   }
   bool delivered(uint32_t i, uint32_t j) const {
@@ -104,6 +127,50 @@ struct Net {
 inline bool churn_fires(uint64_t seed, uint32_t r, uint32_t cut) {
   return random_u32(seed, STREAM_CHURN, r, 0, 0) < cut;
 }
+
+// SPEC §6c crash-recover transitions — the scalar twin of
+// ops/adversary.crash_transition. Both draws are pure counter
+// functions of (seed, round, node); only the down mask is history.
+// Order within the round: recoveries decided on the start-of-round
+// down set, crashes on the post-recovery up set, the max_crashed cap
+// admitting would-be crashers in ascending id order.
+struct CrashAdv {
+  bool on = false;
+  std::vector<uint8_t> down, up, rec;
+
+  void init(uint32_t n, uint32_t crash_cut) {
+    on = crash_cut > 0;
+    down.assign(n, 0);
+    up.assign(n, 1);
+    rec.assign(n, 0);
+  }
+  const uint8_t* up_mask() const { return on ? up.data() : nullptr; }
+  bool is_down(uint32_t i) const { return on && down[i]; }
+
+  void advance(uint64_t seed, uint32_t r, uint32_t crash_cut,
+               uint32_t recover_cut, uint32_t max_crashed) {
+    if (!on) return;
+    const uint32_t n = uint32_t(down.size());
+    uint32_t still_cnt = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+      rec[i] = down[i] &&
+               random_u32(seed, STREAM_CRASH, r, 1, i) < recover_cut;
+      if (down[i] && !rec[i]) ++still_cnt;
+    }
+    uint32_t rank = 0;  // cumsum over the RAW want mask, id-ascending
+    for (uint32_t i = 0; i < n; ++i) {
+      const bool still = down[i] && !rec[i];
+      bool want = !still &&
+                  random_u32(seed, STREAM_CRASH, r, 0, i) < crash_cut;
+      if (want) {
+        ++rank;
+        if (max_crashed > 0 && still_cnt + rank > max_crashed) want = false;
+      }
+      down[i] = still || want;
+      up[i] = !down[i];
+    }
+  }
+};
 
 // ---------------------------------------------------------------------------
 // Raft (SPEC §3).
@@ -118,6 +185,9 @@ struct RaftSim {
   // "silent" (withhold every send), 1 -> "equivocate" (double-grant).
   uint32_t n_byz = 0, byz_equiv = 0;
   uint32_t delivery = DELIVERY_AUTO;
+  // SPEC §6c / §A.2 adversary knobs (0 = off).
+  uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
+  CrashAdv crash;
 
   // Auto: the capped round queries only O(A·N) edges — edge-wise makes
   // it tractable at 100k nodes; the dense round touches ~every edge ~7
@@ -171,6 +241,27 @@ struct RaftSim {
       lead_next.assign(size_t(A) * N, 1);
     }
     for (uint32_t i = 0; i < N; ++i) timeout[i] = draw_timeout(0, i);
+    crash.init(N, crash_cut);
+  }
+
+  // SPEC §6c round prologue shared by both rounds: advance the down
+  // mask, apply the volatile reset on recovery (role/timer; the dense
+  // engine also re-inits the recovering node's leader bookkeeping rows
+  // — the capped engine's tracked-slot lifecycle re-inits on entry).
+  // Down nodes' delivery is killed via Net's up mask; every local
+  // state mutation below is guarded on up, which together equal the
+  // JAX engines' freeze (a down node's state can only move through
+  // those local steps once its edges are dead).
+  void crash_prologue(uint32_t r) {
+    crash.advance(seed, r, crash_cut, recover_cut, max_crashed);
+    if (!crash.on) return;
+    for (uint32_t i = 0; i < N; ++i)
+      if (crash.rec[i]) {
+        role[i] = ROLE_F;
+        timer[i] = 0;
+        if (A == 0)
+          for (uint32_t j = 0; j < N; ++j) { mi(i, j) = 0; ni(i, j) = 1; }
+      }
   }
 
   // SPEC §3b active set: ids of the top-A ``mask`` nodes by
@@ -190,17 +281,21 @@ struct RaftSim {
 
   void round(uint32_t r) {
     const uint32_t majority = N / 2 + 1;
-    net.begin_round(seed, N, r, drop_cut, part_cut, edge_net());
+    crash_prologue(r);
+    net.begin_round(seed, N, r, drop_cut, part_cut, edge_net(), max_delay,
+                    crash.up_mask());
     std::vector<uint8_t> reset(N, 0);
 
     // ---- P0 churn: all leaders step down.
     if (churn_fires(seed, r, churn_cut))
       for (uint32_t i = 0; i < N; ++i)
-        if (role[i] == ROLE_L) { role[i] = ROLE_F; timer[i] = 0; reset[i] = 1; }
+        if (!crash.is_down(i) && role[i] == ROLE_L) {
+          role[i] = ROLE_F; timer[i] = 0; reset[i] = 1;
+        }
 
     // ---- P1 candidacy.
     for (uint32_t i = 0; i < N; ++i)
-      if (role[i] != ROLE_L && timer[i] >= timeout[i]) {
+      if (!crash.is_down(i) && role[i] != ROLE_L && timer[i] >= timeout[i]) {
         term[i] += 1;
         role[i] = ROLE_C;
         voted_for[i] = int32_t(i);
@@ -247,6 +342,7 @@ struct RaftSim {
     }
     // P2c: tally; winners become leaders.
     for (uint32_t c = 0; c < N; ++c) {
+      if (crash.is_down(c)) continue;   // SPEC §6c: frozen while down
       if (role[c] != ROLE_C) continue;  // may have been bumped in P2a
       uint32_t votes = 1;  // self
       for (uint32_t j = 0; j < N; ++j) {
@@ -272,7 +368,8 @@ struct RaftSim {
     // ---- P3 replication.
     // (a) propose.
     for (uint32_t l = 0; l < N; ++l)
-      if (role[l] == ROLE_L && log_len[l] < E && log_len[l] < L) {
+      if (!crash.is_down(l) && role[l] == ROLE_L && log_len[l] < E &&
+          log_len[l] < L) {
         lt(l, log_len[l]) = term[l];
         lv(l, log_len[l]) = random_u32(seed, STREAM_VALUE, r, 0, l);
         log_len[l] += 1;
@@ -294,6 +391,7 @@ struct RaftSim {
     std::vector<uint8_t> ack_ok(N, 0);
     std::vector<uint32_t> ack_match(N, 0), ack_term(N, 0);
     for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
       uint32_t T = term[j];
       for (uint32_t l = 0; l < N; ++l)
         if (was_leader[l] && net.delivered(l, j)) T = std::max(T, s_term[l]);
@@ -327,6 +425,7 @@ struct RaftSim {
     }
     // (d) leaders process acks (only if still leader after (c)).
     for (uint32_t l = 0; l < N; ++l) {
+      if (crash.is_down(l)) continue;  // SPEC §6c: frozen while down
       if (!was_leader[l] || role[l] != ROLE_L) continue;
       uint32_t T = term[l];
       for (uint32_t j = 0; j < N; ++j)
@@ -356,6 +455,7 @@ struct RaftSim {
 
     // ---- P4 timers.
     for (uint32_t i = 0; i < N; ++i) {
+      if (crash.is_down(i)) continue;  // SPEC §6c: frozen while down
       if (role[i] == ROLE_L) timer[i] = 0;
       else if (!reset[i]) timer[i] += 1;
     }
@@ -375,17 +475,21 @@ struct RaftSim {
   // (docs/PERF.md "oracle asymptotics").
   void round_capped(uint32_t r) {
     const uint32_t majority = N / 2 + 1;
-    net.begin_round(seed, N, r, drop_cut, part_cut, edge_net());
+    crash_prologue(r);
+    net.begin_round(seed, N, r, drop_cut, part_cut, edge_net(), max_delay,
+                    crash.up_mask());
     std::vector<uint8_t> reset(N, 0);
 
     // ---- P0 churn.
     if (churn_fires(seed, r, churn_cut))
       for (uint32_t i = 0; i < N; ++i)
-        if (role[i] == ROLE_L) { role[i] = ROLE_F; timer[i] = 0; reset[i] = 1; }
+        if (!crash.is_down(i) && role[i] == ROLE_L) {
+          role[i] = ROLE_F; timer[i] = 0; reset[i] = 1;
+        }
 
     // ---- P1 candidacy.
     for (uint32_t i = 0; i < N; ++i)
-      if (role[i] != ROLE_L && timer[i] >= timeout[i]) {
+      if (!crash.is_down(i) && role[i] != ROLE_L && timer[i] >= timeout[i]) {
         term[i] += 1;
         role[i] = ROLE_C;
         voted_for[i] = int32_t(i);
@@ -393,10 +497,11 @@ struct RaftSim {
         timeout[i] = draw_timeout(term[i], i);
       }
 
-    // ---- P2 election over the active candidate set.
+    // ---- P2 election over the active candidate set (down candidates
+    // are untracked — SPEC §6c: they send nothing).
     std::vector<uint8_t> is_cand(N);
     for (uint32_t i = 0; i < N; ++i)
-      is_cand[i] = role[i] == ROLE_C &&
+      is_cand[i] = role[i] == ROLE_C && !crash.is_down(i) &&
                    (!withhold() || honest(i));  // SPEC §3c silent byz
     const std::vector<int32_t> cand_ids = top_active(is_cand);
     std::vector<uint8_t> active_cand(N, 0);
@@ -466,8 +571,10 @@ struct RaftSim {
     // ---- Tracked-leader slot lifecycle: rows follow ids; entries and
     // re-entries get fresh election-time rows (match 0 except self,
     // next = log_len + 1 — log_len BEFORE this round's P3a append).
+    // Down leaders are untracked (SPEC §6c: they replicate nothing).
     std::vector<uint8_t> is_lead(N);
-    for (uint32_t i = 0; i < N; ++i) is_lead[i] = role[i] == ROLE_L;
+    for (uint32_t i = 0; i < N; ++i)
+      is_lead[i] = role[i] == ROLE_L && !crash.is_down(i);
     const std::vector<int32_t> new_ids = top_active(is_lead);
     std::vector<uint32_t> nmatch(size_t(A) * N, 0), nnext(size_t(A) * N, 1);
     for (uint32_t k = 0; k < A; ++k) {
@@ -493,7 +600,8 @@ struct RaftSim {
     // ---- P3a propose: every leader appends locally (tracked or not);
     // tracked leaders' self-match follows their own append.
     for (uint32_t l = 0; l < N; ++l)
-      if (role[l] == ROLE_L && log_len[l] < E && log_len[l] < L) {
+      if (!crash.is_down(l) && role[l] == ROLE_L && log_len[l] < E &&
+          log_len[l] < L) {
         lt(l, log_len[l]) = term[l];
         lv(l, log_len[l]) = random_u32(seed, STREAM_VALUE, r, 0, l);
         log_len[l] += 1;
@@ -520,6 +628,7 @@ struct RaftSim {
     std::vector<uint8_t> ack_ok(N, 0);
     std::vector<uint32_t> ack_match(N, 0), ack_term(N, 0);
     for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
       uint32_t T = term[j];
       for (uint32_t k = 0; k < A; ++k)
         if (was_lead_k[k] && net.delivered(uint32_t(lead_id[k]), j))
@@ -589,6 +698,7 @@ struct RaftSim {
 
     // ---- P4 timers.
     for (uint32_t i = 0; i < N; ++i) {
+      if (crash.is_down(i)) continue;  // SPEC §6c: frozen while down
       if (role[i] == ROLE_L) timer[i] = 0;
       else if (!reset[i]) timer[i] += 1;
     }
@@ -614,6 +724,9 @@ struct PbftSim {
   uint32_t fault_bcast = 0;  // SPEC §6b broadcast-atomic fault model
   uint32_t drop_cut, part_cut, churn_cut;
   uint32_t delivery = DELIVERY_AUTO;
+  // SPEC §6c / §A.2 adversary knobs (0 = off).
+  uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
+  CrashAdv crash;
 
   // The §6 dense tallies walk ~every (i, j) pair anyway, so the
   // materialized Net stays the auto choice for the edge fault model;
@@ -653,14 +766,20 @@ struct PbftSim {
     std::vector<uint8_t> bcast, side;  // [N]
 
     void begin_round(uint64_t seed_, uint32_t n, uint32_t r_,
-                     uint32_t drop_cut, uint32_t part_cut) {
+                     uint32_t drop_cut, uint32_t part_cut,
+                     uint32_t max_delay = 0, const uint8_t* up = nullptr) {
       seed = seed_;
       r = r_;
       bcast.resize(n);
       side.assign(n, 0);
       part_active = random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
       for (uint32_t i = 0; i < n; ++i) {
-        bcast[i] = delivery_u32(seed, r, i, i) >= drop_cut;
+        // SPEC §A.2 delayed retransmission on the broadcast key (i, i);
+        // SPEC §6c folds a down sender's broadcast drop in atomically.
+        bool b = delivery_u32(seed, r, i, i) >= drop_cut;
+        if (!b && max_delay)
+          b = delayed_open(seed, r, i, i, drop_cut, max_delay);
+        bcast[i] = b && (!up || up[i]);
         if (part_active)
           side[i] = random_u32(seed, STREAM_PARTITION, r, 1, i) & 1u;
       }
@@ -690,11 +809,23 @@ struct PbftSim {
     committed.assign(size_t(N) * S, 0);
     pp_view.assign(size_t(N) * S, 0); pp_val.assign(size_t(N) * S, 0);
     dval.assign(size_t(N) * S, 0);
+    crash.init(N, crash_cut);
     for (uint32_t r = 0; r < R; ++r) {
+      // SPEC §6c prologue: advance the down mask, volatile reset on
+      // recovery (view/timer rejoin at 0; the per-slot message log is
+      // the persisted state PBFT's safety argument rests on). Down
+      // nodes neither send (Net up mask / folded bcast) nor mutate
+      // local state (per-receiver guards in the rounds below).
+      crash.advance(seed, r, crash_cut, recover_cut, max_crashed);
+      if (crash.on)
+        for (uint32_t i = 0; i < N; ++i)
+          if (crash.rec[i]) { view[i] = 0; timer[i] = 0; }
       if (fault_bcast)
-        bnet.begin_round(seed, N, r, drop_cut, part_cut);
+        bnet.begin_round(seed, N, r, drop_cut, part_cut, max_delay,
+                         crash.up_mask());
       else
-        net.begin_round(seed, N, r, drop_cut, part_cut, edge_net());
+        net.begin_round(seed, N, r, drop_cut, part_cut, edge_net(),
+                        max_delay, crash.up_mask());
       if (bcast_fast())
         round_bcast_fast(r);
       else
@@ -725,6 +856,7 @@ struct PbftSim {
       }
     }
     for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
       uint32_t prim = view[j] % N;
       bool prim_byz = equiv && !honest(prim);
       bool pdel = prim == j || del(r, prim, j);
@@ -764,12 +896,14 @@ struct PbftSim {
     // P0 churn.
     if (churn_fires(seed, r, churn_cut))
       for (uint32_t i = 0; i < N; ++i) {
+        if (crash.is_down(i)) continue;
         view[i] += 1; timer[i] = 0; reset[i] = 1;
       }
 
     // P1 view catch-up ((f+1)-th largest delivered honest view ∪ own).
     s_view = view;
     for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
       views_in.clear();
       views_in.push_back(s_view[j]);
       for (uint32_t i = 0; i < N; ++i)
@@ -785,7 +919,7 @@ struct PbftSim {
 
     // P2 timeout.
     for (uint32_t j = 0; j < N; ++j)
-      if (timer[j] >= view_timeout) {
+      if (!crash.is_down(j) && timer[j] >= view_timeout) {
         view[j] += 1; timer[j] = 0; reset[j] = 1;
       }
 
@@ -796,6 +930,7 @@ struct PbftSim {
     s_seen = pp_seen; s_val = pp_val;
     for (uint32_t j = 0; j < N; ++j)
       for (uint32_t s = 0; s < S; ++s) {
+        if (crash.is_down(j)) break;  // SPEC §6c: frozen while down
         if (!s_seen[at(j, s)] || prepared[at(j, s)]) continue;
         uint32_t cnt = 0;
         for (uint32_t i = 0; i < N; ++i) {
@@ -814,6 +949,7 @@ struct PbftSim {
     s_prep = prepared;
     for (uint32_t j = 0; j < N; ++j)
       for (uint32_t s = 0; s < S; ++s) {
+        if (crash.is_down(j)) break;  // SPEC §6c: frozen while down
         if (!s_prep[at(j, s)] || committed[at(j, s)]) continue;
         uint32_t cnt = 0;
         for (uint32_t i = 0; i < N; ++i) {
@@ -836,6 +972,7 @@ struct PbftSim {
     s_comm = committed; s_dval = dval;
     for (uint32_t j = 0; j < N; ++j)
       for (uint32_t s = 0; s < S; ++s) {
+        if (crash.is_down(j)) break;  // SPEC §6c: frozen while down
         if (s_comm[at(j, s)]) continue;
         for (uint32_t i = 0; i < N; ++i)  // ascending ⇒ lowest id wins
           if (honest(i) && s_comm[at(i, s)] && del(r, i, j)) {
@@ -848,6 +985,7 @@ struct PbftSim {
 
     // P7 timer.
     for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
       if (new_commit[j]) timer[j] = 0;
       else if (!reset[j]) timer[j] += 1;
     }
@@ -875,6 +1013,7 @@ struct PbftSim {
     // P0 churn.
     if (churn_fires(seed, r, churn_cut))
       for (uint32_t i = 0; i < N; ++i) {
+        if (crash.is_down(i)) continue;
         view[i] += 1; timer[i] = 0; reset[i] = 1;
       }
 
@@ -900,6 +1039,7 @@ struct PbftSim {
         a2[b] = K >= 2 ? v[K - 2] : std::numeric_limits<int64_t>::max();
       }
       for (uint32_t j = 0; j < N; ++j) {
+        if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
         const uint32_t b = side_of(j);
         const int64_t x = int64_t(view[j]);
         const bool in_set = honest(j) && bnet.bcast[j];
@@ -911,7 +1051,7 @@ struct PbftSim {
 
     // P2 timeout.
     for (uint32_t j = 0; j < N; ++j)
-      if (timer[j] >= view_timeout) {
+      if (!crash.is_down(j) && timer[j] >= view_timeout) {
         view[j] += 1; timer[j] = 0; reset[j] = 1;
       }
 
@@ -965,15 +1105,19 @@ struct PbftSim {
         if (equiv && n_byz > 0) c += eqb[side_of(j)] - eq_send[j];
         return c;
       };
-      // P4 prepare tally (value-matched, incl. self).
+      // P4 prepare tally (value-matched, incl. self). A down receiver
+      // can neither prepare nor commit (SPEC §6c) — down SENDERS are
+      // already outside every count via the folded bcast flag.
       tally(pp_seen);
       for (uint32_t j = 0; j < N; ++j) {
+        if (crash.is_down(j)) continue;
         if (!pp_seen[at(j, s)] || prepared[at(j, s)]) continue;
         if (count_for(j) >= Q) prepared[at(j, s)] = 1;
       }
       // P5 commit tally over post-P4 prepared.
       tally(prepared);
       for (uint32_t j = 0; j < N; ++j) {
+        if (crash.is_down(j)) continue;
         if (!prepared[at(j, s)] || committed[at(j, s)]) continue;
         if (count_for(j) >= Q) {
           committed[at(j, s)] = 1;
@@ -992,6 +1136,7 @@ struct PbftSim {
         if (imin[b] == N) { imin[b] = i; --unset; }  // ascending ⇒ lowest id
       }
       for (uint32_t j = 0; j < N; ++j) {
+        if (crash.is_down(j)) continue;  // down receivers adopt nothing
         if (committed[at(j, s)]) continue;
         const uint32_t b = side_of(j);
         if (imin[b] == N) continue;
@@ -1003,6 +1148,7 @@ struct PbftSim {
 
     // P7 timer.
     for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
       if (new_commit[j]) timer[j] = 0;
       else if (!reset[j]) timer[j] += 1;
     }
@@ -1018,6 +1164,9 @@ struct PaxosSim {
   uint32_t N, R, S, P;
   uint32_t drop_cut, part_cut, churn_cut;
   uint32_t delivery = DELIVERY_AUTO;
+  // SPEC §6c / §A.2 adversary knobs (0 = off).
+  uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
+  CrashAdv crash;
 
   // Auto: the round only ever queries proposer↔acceptor edges — ~7·P·N
   // mixer evals edge-wise vs N² materialized — so the crossover sits at
@@ -1050,8 +1199,21 @@ struct PaxosSim {
     std::vector<uint32_t> touched;
     touched.reserve(P);
 
+    crash.init(N, crash_cut);
     for (uint32_t r = 0; r < R; ++r) {
-      net.begin_round(seed, N, r, drop_cut, part_cut, edge_net());
+      // SPEC §6c prologue: promised[] is the volatile state (safe —
+      // ballots strictly increase across rounds); acceptor history and
+      // learner state persist. A down node's flights die via Net's up
+      // mask; a down proposer therefore never gathers promises, and a
+      // down acceptor's per-slot writes never trigger (its touched
+      // lists stay empty) — only the learner loop needs a guard.
+      crash.advance(seed, r, crash_cut, recover_cut, max_crashed);
+      if (crash.on)
+        for (uint32_t i = 0; i < N; ++i)
+          if (crash.rec[i])
+            for (uint32_t s = 0; s < S; ++s) promised[at(i, s)] = 0;
+      net.begin_round(seed, N, r, drop_cut, part_cut, edge_net(), max_delay,
+                      crash.up_mask());
       const bool churn = churn_fires(seed, r, churn_cut);
       for (uint32_t p = 0; p < P; ++p) {
         slot[p] = random_u32(seed, STREAM_VALUE, r, 1, p) % S;
@@ -1132,6 +1294,7 @@ struct PaxosSim {
       // Learn: lowest-id decider per slot, first-learned-wins.
       for (uint32_t n = 0; n < N; ++n)
         for (uint32_t p = 0; p < P; ++p) {
+          if (crash.is_down(n)) break;  // SPEC §6c: frozen while down
           if (!decided[p]) continue;
           if (p != n && !net.delivered(p, n)) continue;
           uint32_t s = slot[p];
@@ -1152,6 +1315,10 @@ struct DposSim {
   uint64_t seed;
   uint32_t V, R, L, C, K, epoch_len;
   uint32_t drop_cut, part_cut, churn_cut;
+  // SPEC §6c / §A.1 / §A.2 adversary knobs (0 = off).
+  uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0;
+  uint32_t miss_cut = 0, max_delay = 0;
+  CrashAdv crash;
 
   std::vector<uint32_t> chain_r, chain_p;  // [V*L]
   std::vector<uint32_t> chain_len;         // [V]
@@ -1198,18 +1365,32 @@ struct DposSim {
       for (uint32_t k = 0; k < K; ++k) producers[size_t(e) * K + k] = order[k];
     }
 
+    crash.init(V, crash_cut);
     for (uint32_t r = 0; r < R; ++r) {
+      // SPEC §6c advances EVERY round (churned or not — the down mask
+      // is history); the chain is durable, so recovery needs no reset.
+      crash.advance(seed, r, crash_cut, recover_cut, max_crashed);
       if (churn_fires(seed, r, churn_cut)) continue;  // producer offline
       uint32_t e = r / epoch_len, t = r % epoch_len;
       uint32_t p = producers[size_t(e) * K + t % K];
+      // SPEC §A.1 per-producer slot miss: skipped chain-wide, keyed
+      // (round, producer) so failures correlate with the schedule.
+      if (miss_cut && random_u32(seed, STREAM_SLOTMISS, r, 0, p) < miss_cut)
+        continue;
+      if (crash.is_down(p)) continue;  // SPEC §6c: down producer, no block
       bool part_active = random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
       uint32_t side_p = random_u32(seed, STREAM_PARTITION, r, 1, p) & 1u;
       for (uint32_t v = 0; v < V; ++v) {
+        if (crash.is_down(v)) continue;  // down validators stop growing
         bool recv;
         if (v == p) {
           recv = true;
         } else {
           recv = delivery_u32(seed, r, p, v) >= drop_cut;
+          // SPEC §A.2 delayed retransmission repairs the drop leg only
+          // (partitions are topology faults).
+          if (!recv && max_delay)
+            recv = delayed_open(seed, r, p, v, drop_cut, max_delay);
           if (recv && part_active)
             recv = (random_u32(seed, STREAM_PARTITION, r, 1, v) & 1u) == side_p;
         }
@@ -1249,6 +1430,8 @@ class RaftEngine final : public Engine {
     sim_.A = c.max_active;
     sim_.n_byz = c.n_byzantine; sim_.byz_equiv = c.byz_equivocate;
     sim_.delivery = c.oracle_delivery;
+    sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
+    sim_.max_crashed = c.max_crashed; sim_.max_delay = c.max_delay;
     sim_.run();
     return 0;
   }
@@ -1307,6 +1490,8 @@ class PbftEngine final : public SlotEngine<PbftSim> {
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
     sim_.delivery = c.oracle_delivery;
+    sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
+    sim_.max_crashed = c.max_crashed; sim_.max_delay = c.max_delay;
     sim_.run();
     return 0;
   }
@@ -1330,6 +1515,8 @@ class PaxosEngine final : public SlotEngine<PaxosSim> {
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
     sim_.delivery = c.oracle_delivery;
+    sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
+    sim_.max_crashed = c.max_crashed; sim_.max_delay = c.max_delay;
     sim_.run();
     return 0;
   }
@@ -1353,6 +1540,9 @@ class DposEngine final : public Engine {
     sim_.epoch_len = c.epoch_len;
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
+    sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
+    sim_.max_crashed = c.max_crashed;
+    sim_.miss_cut = c.miss_cut; sim_.max_delay = c.max_delay;
     sim_.run();
     return 0;
   }
@@ -1404,13 +1594,17 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t n_byzantine,    // SPEC §3c minority size
                   uint32_t byz_equivocate, // 0 silent, 1 double-grant
                   uint32_t oracle_delivery,  // 0 auto, 1 dense, 2 edge
+                  uint32_t crash_cut,      // SPEC §6c crash cutoff
+                  uint32_t recover_cut,    // SPEC §6c recovery cutoff
+                  uint32_t max_crashed,    // SPEC §6c cap (0 = none)
+                  uint32_t max_delay,      // SPEC §A.2 horizon (0 = off)
                   uint32_t* out_commit,    // [N]
                   uint32_t* out_log_term,  // [N*L]
                   uint32_t* out_log_val,   // [N*L]
                   uint32_t* out_term,      // [N]
                   uint32_t* out_role) {    // [N]
   if (n_nodes == 0 || t_max <= t_min || max_active > n_nodes ||
-      n_byzantine > n_nodes || oracle_delivery > 2)
+      n_byzantine > n_nodes || oracle_delivery > 2 || max_delay > 16)
     return 1;
   ctpu::RaftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
@@ -1419,6 +1613,8 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.A = max_active;
   sim.n_byz = n_byzantine; sim.byz_equiv = byz_equivocate;
   sim.delivery = oracle_delivery;
+  sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
+  sim.max_crashed = max_crashed; sim.max_delay = max_delay;
   sim.run();
   std::memcpy(out_commit, sim.commit.data(), sizeof(uint32_t) * n_nodes);
   std::memcpy(out_log_term, sim.log_term.data(),
@@ -1436,10 +1632,14 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t fault_bcast,     // SPEC §6b broadcast faults
                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
                   uint32_t oracle_delivery,  // 0 auto, 1 dense, 2 edge
+                  uint32_t crash_cut, uint32_t recover_cut,  // SPEC §6c
+                  uint32_t max_crashed,
+                  uint32_t max_delay,        // SPEC §A.2 horizon (0 = off)
                   uint8_t* out_committed,   // [N*S]
                   uint32_t* out_dval,       // [N*S]
                   uint32_t* out_view) {     // [N]
-  if (n_nodes != 3 * f + 1 || n_byzantine > f || oracle_delivery > 2)
+  if (n_nodes != 3 * f + 1 || n_byzantine > f || oracle_delivery > 2 ||
+      max_delay > 16)
     return 1;
   ctpu::PbftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
@@ -1448,6 +1648,8 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.fault_bcast = fault_bcast;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.delivery = oracle_delivery;
+  sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
+  sim.max_crashed = max_crashed; sim.max_delay = max_delay;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_committed, sim.committed.data(), ns);
@@ -1460,17 +1662,23 @@ int ctpu_paxos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                    uint32_t n_slots, uint32_t n_proposers,
                    uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
                    uint32_t oracle_delivery,    // 0 auto, 1 dense, 2 edge
+                   uint32_t crash_cut, uint32_t recover_cut,  // SPEC §6c
+                   uint32_t max_crashed,
+                   uint32_t max_delay,          // SPEC §A.2 (0 = off)
                    uint32_t* out_learned_val,   // [N*S]
                    uint8_t* out_learned_mask,   // [N*S]
                    uint32_t* out_promised,      // [N*S]
                    uint32_t* out_acc_bal,       // [N*S]
                    uint32_t* out_acc_val) {     // [N*S]
-  if (n_nodes == 0 || n_slots == 0 || oracle_delivery > 2) return 1;
+  if (n_nodes == 0 || n_slots == 0 || oracle_delivery > 2 || max_delay > 16)
+    return 1;
   ctpu::PaxosSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
   sim.P = n_proposers ? n_proposers : n_nodes;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.delivery = oracle_delivery;
+  sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
+  sim.max_crashed = max_crashed; sim.max_delay = max_delay;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_learned_val, sim.learned_val.data(), sizeof(uint32_t) * ns);
@@ -1485,17 +1693,25 @@ int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t log_capacity, uint32_t n_candidates,
                   uint32_t n_producers, uint32_t epoch_len,
                   uint32_t drop_cut, uint32_t part_cut, uint32_t churn_cut,
+                  uint32_t crash_cut, uint32_t recover_cut,  // SPEC §6c
+                  uint32_t max_crashed,
+                  uint32_t miss_cut,        // SPEC §A.1 slot-miss cutoff
+                  uint32_t max_delay,       // SPEC §A.2 horizon (0 = off)
                   uint32_t* out_chain_r,    // [V*L]
                   uint32_t* out_chain_p,    // [V*L]
                   uint32_t* out_chain_len,  // [V]
                   int32_t* out_lib) {       // [V] SPEC §7 LIB, -1 = none
   if (n_nodes == 0 || n_candidates == 0 || n_producers == 0 ||
-      n_producers > n_candidates || n_candidates > n_nodes || epoch_len == 0)
+      n_producers > n_candidates || n_candidates > n_nodes ||
+      epoch_len == 0 || max_delay > 16)
     return 1;
   ctpu::DposSim sim;
   sim.seed = seed; sim.V = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
   sim.C = n_candidates; sim.K = n_producers; sim.epoch_len = epoch_len;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
+  sim.max_crashed = max_crashed;
+  sim.miss_cut = miss_cut; sim.max_delay = max_delay;
   sim.run();
   size_t vl = size_t(n_nodes) * log_capacity;
   std::memcpy(out_chain_r, sim.chain_r.data(), sizeof(uint32_t) * vl);

@@ -16,16 +16,19 @@
 extern "C" {
 int ctpu_raft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint32_t, uint32_t*, uint32_t*, uint32_t*,
-                  uint32_t*, uint32_t*);
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t*, uint32_t*, uint32_t*, uint32_t*, uint32_t*);
 int ctpu_pbft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint8_t*, uint32_t*, uint32_t*);
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint8_t*, uint32_t*, uint32_t*);
 int ctpu_paxos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                   uint32_t, uint32_t, uint32_t, uint32_t*, uint8_t*,
+                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                   uint32_t, uint32_t*, uint8_t*,
                    uint32_t*, uint32_t*, uint32_t*);
 int ctpu_dpos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t*, uint32_t*,
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t, uint32_t, uint32_t*, uint32_t*,
                   uint32_t*, int32_t*);
 }
 
@@ -35,6 +38,10 @@ namespace {
 constexpr uint32_t DROP = 429496729u;
 constexpr uint32_t PART = 214748364u;
 constexpr uint32_t CHURN = 214748364u;
+// SPEC §6c / §A.1 cutoffs (~15% crash, ~30% recover, ~40% slot miss).
+constexpr uint32_t CRASH = 644245094u;
+constexpr uint32_t REC = 1288490188u;
+constexpr uint32_t MISS = 1717986918u;
 
 int fail(const char* what) {
   std::fprintf(stderr, "selftest FAILED: %s\n", what);
@@ -80,40 +87,56 @@ int main() {
     size_t W = N + 2 * size_t(N) * L + N + N;
     rc |= run_twice("raft", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
-                           0, o, o + N, o + N + size_t(N) * L,
+                           0, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // Capped engine (SPEC §3b): same shapes, max_active = 3.
     rc |= run_twice("raft-capped", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
-                           0, o, o + N, o + N + size_t(N) * L,
+                           0, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // SPEC §3c adversaries: withholding and double-granting minorities.
     rc |= run_twice("raft-byz-silent", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 2, 0,
-                           0, o, o + N, o + N + size_t(N) * L,
+                           0, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     rc |= run_twice("raft-byz-equiv", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 2, 1,
-                           0, o, o + N, o + N + size_t(N) * L,
+                           0, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // Edge-wise vs dense delivery: byte-identical on both engines.
     rc |= run_match("raft-delivery", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
-                           d, o, o + N, o + N + size_t(N) * L,
+                           d, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     rc |= run_match("raft-capped-delivery", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
-                           d, o, o + N, o + N + size_t(N) * L,
+                           d, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
+                           o + N + 2 * size_t(N) * L,
+                           o + 2 * N + 2 * size_t(N) * L);
+    });
+    // SPEC §6c crash-recover + §A.2 delayed retransmission (the
+    // adversary-library mirror), dense vs edge delivery.
+    rc |= run_match("raft-crash-delay", W, [&](uint32_t* o, uint32_t d) {
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
+                           d, CRASH, REC, 2, 4, o, o + N,
+                           o + N + size_t(N) * L,
+                           o + N + 2 * size_t(N) * L,
+                           o + 2 * N + 2 * size_t(N) * L);
+    });
+    rc |= run_match("raft-capped-crash", W, [&](uint32_t* o, uint32_t d) {
+      return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
+                           d, CRASH, REC, 0, 3, o, o + N,
+                           o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
@@ -124,31 +147,38 @@ int main() {
     // committed (u8, round up to words) + dval + view
     size_t W = (ns + 3) / 4 + ns + N;
     rc |= run_twice("pbft", W, [&](uint32_t* o) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, 0, DROP, PART, CHURN, 0,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     rc |= run_twice("pbft-equiv", W, [&](uint32_t* o) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, 0,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // SPEC §6b broadcast-atomic fault model, with equivocation.
     rc |= run_twice("pbft-bcast", W, [&](uint32_t* o) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, 0,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, 0, 0, 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // §6 edge model: dense vs forced edge-wise delivery queries.
     rc |= run_match("pbft-delivery", W, [&](uint32_t* o, uint32_t d) {
-      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, d,
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, d, 0, 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // §6b: the per-(slot, side) aggregate round (auto/edge) vs the
     // direct per-receiver definition (forced dense).
     rc |= run_match("pbft-bcast-agg", W, [&](uint32_t* o, uint32_t d) {
+      return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d, 0, 0, 0, 0,
+                           reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
+                           o + (ns + 3) / 4 + ns);
+    });
+    // §6b aggregate vs direct under §6c crash + §A.2 delay.
+    rc |= run_match("pbft-bcast-crash", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d,
+                           CRASH, REC, 2, 3,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -158,12 +188,12 @@ int main() {
     size_t ns = size_t(N) * S;
     size_t W = ns + (ns + 3) / 4 + 3 * ns;
     rc |= run_twice("paxos", W, [&](uint32_t* o) {
-      return ctpu_paxos_run(55, N, R, S, 0, DROP, PART, CHURN, 0, o,
+      return ctpu_paxos_run(55, N, R, S, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0, o,
                             reinterpret_cast<uint8_t*>(o + ns), o + ns + (ns + 3) / 4,
                             o + ns + (ns + 3) / 4 + ns, o + ns + (ns + 3) / 4 + 2 * ns);
     });
     rc |= run_match("paxos-delivery", W, [&](uint32_t* o, uint32_t d) {
-      return ctpu_paxos_run(55, N, R, S, 2, DROP, PART, CHURN, d, o,
+      return ctpu_paxos_run(55, N, R, S, 2, DROP, PART, CHURN, d, 0, 0, 0, 0, o,
                             reinterpret_cast<uint8_t*>(o + ns), o + ns + (ns + 3) / 4,
                             o + ns + (ns + 3) / 4 + ns, o + ns + (ns + 3) / 4 + 2 * ns);
     });
@@ -173,7 +203,14 @@ int main() {
     size_t vl = size_t(V) * L;
     size_t W = 2 * vl + 2 * V;  // chains + chain_len + lib
     rc |= run_twice("dpos", W, [&](uint32_t* o) {
-      return ctpu_dpos_run(33, V, R, L, C, K, EP, DROP, PART, CHURN, o, o + vl,
+      return ctpu_dpos_run(33, V, R, L, C, K, EP, DROP, PART, CHURN, 0, 0, 0, 0, 0, o, o + vl,
+                           o + 2 * vl,
+                           reinterpret_cast<int32_t*>(o + 2 * vl + V));
+    });
+    // §A.1 slot miss + §A.2 delay + §6c crash composed.
+    rc |= run_twice("dpos-adversary", W, [&](uint32_t* o) {
+      return ctpu_dpos_run(33, V, R, L, C, K, EP, DROP, PART, CHURN,
+                           CRASH, REC, 5, MISS, 4, o, o + vl,
                            o + 2 * vl,
                            reinterpret_cast<int32_t*>(o + 2 * vl + V));
     });

@@ -19,6 +19,9 @@ constexpr uint32_t STREAM_VOTE      = 0xD3A2646Cu;
 constexpr uint32_t STREAM_VALUE     = 0xFD7046C5u;
 constexpr uint32_t STREAM_BYZANTINE = 0xB55A4F09u;
 constexpr uint32_t STREAM_EQUIV     = 0x94D049BBu;
+constexpr uint32_t STREAM_CRASH     = 0x68E31DA5u;  // SPEC §6c (mirrored)
+constexpr uint32_t STREAM_SLOTMISS  = 0x7F4A7C15u;  // SPEC §A.1 DPoS slot miss
+constexpr uint32_t STREAM_DELAY     = 0x2545F491u;  // SPEC §A.2 retransmit
 
 inline uint32_t rotl32(uint32_t x, int r) {
   return (x << r) | (x >> (32 - r));
@@ -79,6 +82,30 @@ inline uint32_t delivery_u32(uint64_t seed, uint32_t r, uint32_t i,
                              uint32_t j) {
   uint32_t k0 = static_cast<uint32_t>(seed & 0xFFFFFFFFull) ^ STREAM_DELIVER;
   return mix_fin(mix_absorb(mix_absorb(mix_absorb(k0, r), i), j));
+}
+
+// delay_u32(seed, q, d, i, j) — the SPEC §A.2 delayed-retransmission
+// draw for origin round q, delay d, edge i->j (same mixer, STREAM_DELAY
+// key, FOUR absorbs). Scalar twin of core/rng.py delay_u32_np.
+inline uint32_t delay_u32(uint64_t seed, uint32_t q, uint32_t d, uint32_t i,
+                          uint32_t j) {
+  uint32_t k0 = static_cast<uint32_t>(seed & 0xFFFFFFFFull) ^ STREAM_DELAY;
+  return mix_fin(mix_absorb(mix_absorb(mix_absorb(mix_absorb(k0, q), d), i),
+                            j));
+}
+
+// SPEC §A.2 delayed-openness OR-term: does a flight dropped at some
+// round q in [r - max_delay, r) arrive at r via a successful
+// retransmission? Pure function of (seed, r, edge) — no queue state.
+inline bool delayed_open(uint64_t seed, uint32_t r, uint32_t i, uint32_t j,
+                         uint32_t drop_cut, uint32_t max_delay) {
+  for (uint32_t d = 1; d <= max_delay && d <= r; ++d) {
+    const uint32_t q = r - d;
+    if (delivery_u32(seed, q, i, j) < drop_cut &&
+        delay_u32(seed, q, d, i, j) >= drop_cut)
+      return true;
+  }
+  return false;
 }
 
 }  // namespace ctpu

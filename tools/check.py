@@ -91,6 +91,28 @@ def layer_tidy(_: argparse.Namespace) -> str:
     return "FAIL" if _run(["make", "-C", "cpp", "tidy"]) else "ok"
 
 
+# The `make check` scenario smoke: ONE small scripted-attack run
+# through the real CLI front door, timeline assertions judged by the
+# scenario's own exit status (consensus_tpu/scenarios). The shape IS
+# delay-storm's declared `tuned` reference shape — the one its bounds
+# are verified at — so a smoke red is a real regression, never the
+# off-tuned case the CLI hint disclaims; tests reuse this exact flag
+# list (test_python_cli_scenario_verdict) so the two can't drift.
+SCENARIO_SMOKE = ["-m", "consensus_tpu", "--scenario", "delay-storm",
+                  "--protocol", "raft", "--nodes", "7", "--rounds", "96",
+                  "--log-capacity", "32", "--max-entries", "24",
+                  "--sweeps", "2", "--seed", "11", "--platform", "cpu"]
+
+
+def layer_scenarios(_: argparse.Namespace) -> str:
+    import importlib.util
+    if importlib.util.find_spec("jax") is None:
+        return "SKIP (jax not installed)"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return "FAIL" if _run([sys.executable] + SCENARIO_SMOKE, env=env) \
+        else "ok"
+
+
 def layer_tests(args: argparse.Namespace) -> str:
     if args.skip_tests:
         return "SKIP (--skip-tests)"
@@ -99,7 +121,8 @@ def layer_tests(args: argparse.Namespace) -> str:
 
 
 LAYERS = {"lint": layer_lint, "hlo": layer_hlo, "ruff": layer_ruff,
-          "mypy": layer_mypy, "tidy": layer_tidy, "tests": layer_tests}
+          "mypy": layer_mypy, "tidy": layer_tidy,
+          "scenarios": layer_scenarios, "tests": layer_tests}
 
 
 def main(argv=None) -> int:

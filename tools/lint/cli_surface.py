@@ -34,9 +34,11 @@ CLI = "consensus_tpu/cli.py"
 NATIVE = "cpp/consensus_sim.cpp"
 
 # Python-CLI flags handled outside _FLAG_FIELDS (the --mesh spelling of
-# mesh_shape), and native flags that are not Config fields.
+# mesh_shape), and native flags that are not Config fields (--scenario
+# names a scripted attack from consensus_tpu/scenarios — both front
+# doors parse it, the Python side as a dedicated argparse flag).
 PY_SPECIAL = {"mesh_shape": "--mesh"}
-NATIVE_NON_CONFIG = {"oracle-delivery", "out", "help"}
+NATIVE_NON_CONFIG = {"oracle-delivery", "out", "help", "scenario"}
 
 _NATIVE_FLAG_RE = re.compile(r'k == "--([a-z0-9-]+)"')
 

@@ -27,9 +27,9 @@ a literal constant". This check enforces:
   3. mixer-only streams (STREAM_MIXER_ONLY — the SPEC §2 delivery
      stream) are never drawn through the threefry entry points;
   4. the C++ mirror (cpp/threefry.h) defines the same constants with
-     the same values — minus STREAM_TPU_ONLY (e.g. STREAM_CRASH: SPEC
-     §6c is not implemented by the oracle, and Config rejects it on
-     engine="cpu").
+     the same values — minus STREAM_TPU_ONLY (e.g. STREAM_ATTACK: the
+     SPEC §A.3 targeted Raft attacks are not implemented by the
+     oracle, and Config rejects them on engine="cpu").
 
 Scope: call sites across consensus_tpu/ only. tests/ and benchmarks/
 deliberately drive raw streams for cross-validation and ablations.

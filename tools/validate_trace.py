@@ -32,6 +32,8 @@ TELEMETRY_COUNTERS = frozenset({
     # raft (dense + sparse)
     "leader_elections", "append_accepted", "append_rejected",
     "entries_committed",
+    # raft targeted attacks (SPEC §A.3): attack-active rounds
+    "attack_rounds",
     # pbft (edge + bcast)
     "prepare_quorums", "prepare_missed", "commit_quorums", "commit_missed",
     "commits_adopted", "view_changes",
@@ -39,6 +41,8 @@ TELEMETRY_COUNTERS = frozenset({
     "promises", "nacks", "accepts", "proposals_decided", "values_learned",
     # dpos
     "blocks_appended", "missed_appends", "producer_rotations", "churn_slots",
+    # dpos per-producer slot faults (SPEC §A.1)
+    "missed_slots",
     # crash-recover adversary (SPEC §6c, every engine)
     "crashes", "recoveries", "nodes_down",
 })
@@ -70,6 +74,14 @@ FLIGHT_REPORT_FIELDS = frozenset({
     "window_rounds", "n_windows", "availability", "stall_windows",
     "latency",
 })
+
+# The CLI report's `scenario` verdict block (a --scenario run's
+# timeline-assertion outcome, consensus_tpu/scenarios) — exactly these
+# keys; per-check entries carry {ok, value, bound}.
+SCENARIO_REPORT_FIELDS = frozenset({
+    "name", "passed", "availability", "checks",
+})
+SCENARIO_CHECK_FIELDS = frozenset({"ok", "value", "bound"})
 
 # Every span/event name a framework emitter may write (the
 # docs/OBSERVABILITY.md span inventory). Traces may also carry
@@ -447,6 +459,30 @@ def validate_cli_report(path) -> list:
                                     f"be {N_LATENCY_BUCKETS} ints >= 0")
             elif "latency" in fl:
                 errs.append(f"{path}: flight.latency must be an object")
+    sc = doc.get("scenario")
+    if sc is not None:
+        if not isinstance(sc, dict):
+            errs.append(f"{path}: 'scenario' must be an object")
+        else:
+            for key in sorted(SCENARIO_REPORT_FIELDS - set(sc)):
+                errs.append(f"{path}: scenario missing key {key!r}")
+            for key in sorted(set(sc) - SCENARIO_REPORT_FIELDS):
+                errs.append(f"{path}: scenario key {key!r} is not in the "
+                            "known-field registry (CLI report and "
+                            "validator drifted?)")
+            if "passed" in sc and not isinstance(sc["passed"], bool):
+                errs.append(f"{path}: scenario.passed must be a bool")
+            checks = sc.get("checks")
+            if checks is not None and not isinstance(checks, dict):
+                errs.append(f"{path}: scenario.checks must be an object")
+            elif isinstance(checks, dict):
+                for cname, c in sorted(checks.items()):
+                    if not isinstance(c, dict) \
+                            or set(c) != SCENARIO_CHECK_FIELDS \
+                            or not isinstance(c.get("ok"), bool):
+                        errs.append(
+                            f"{path}: scenario check {cname!r} must be an "
+                            "object with exactly {ok: bool, value, bound}")
     tel = doc.get("telemetry")
     if tel is None:
         return errs
