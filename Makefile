@@ -1,7 +1,8 @@
 # Repo-level entry points. The whole gate is ONE command:
 #
-#   make check     # consensus-lint + hlocheck + ruff + mypy + clang-tidy
-#                  # + scenario smoke + tier-1
+#   make check     # consensus-lint + hlocheck + costcheck + ruff + mypy
+#                  # + clang-tidy + scenario smoke + tier-1
+#   make ledger    # cross-run perf ledger + regression verdict
 #
 # (tools/check.py gates hlocheck on jax and ruff/mypy/clang-tidy on
 # availability and prints a per-layer summary; see
@@ -18,6 +19,12 @@ lint:
 hlocheck:
 	$(PY) -m tools.hlocheck
 
+costcheck:
+	$(PY) -m tools.costmodel
+
+ledger:
+	$(PY) tools/ledger.py --check
+
 tidy:
 	$(MAKE) -C cpp tidy
 
@@ -32,4 +39,5 @@ test:
 	  --continue-on-collection-errors -p no:cacheprovider \
 	  -p no:xdist -p no:randomly
 
-.PHONY: check lint hlocheck tidy san-test scenario-smoke test
+.PHONY: check lint hlocheck costcheck ledger tidy san-test scenario-smoke \
+	test

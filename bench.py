@@ -180,6 +180,24 @@ def run_benchmark(args, metric: str, extra: dict | None = None) -> None:
         "value": round(value, 1),
         "unit": "steps/sec",
         "vs_baseline": round(value / NORTH_STAR_STEPS_PER_SEC, 4),
+        # The machine-parseable trajectory row tools/ledger.py ingests
+        # directly (the metric string above stays for humans and older
+        # consumers; the ledger no longer scrapes it when this block is
+        # present).
+        "trajectory": {
+            "schema": 1,
+            "timestamp": time.time(),
+            "platform": dev.platform,
+            "protocol": cfg.protocol,
+            "nodes": cfg.n_nodes,
+            "rounds": cfg.n_rounds,
+            "sweeps": cfg.n_sweeps,
+            "max_active": cfg.max_active,
+            "steps": steps,
+            "wall_s": round(best, 6),
+            "repeats": args.repeats,
+            "max_committed": committed,
+        },
         **(extra or {}),
     }
     if committed == 0:

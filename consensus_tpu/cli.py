@@ -182,6 +182,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "verdict lands in the report under 'scenario'. "
                          "TPU engine only (the assertions read the flight "
                          "recorder)")
+    ap.add_argument("--serve-port", type=int, default=None, metavar="PORT",
+                    help="serve live run introspection over localhost HTTP "
+                         "while the run executes: /metrics (Prometheus "
+                         "text of the process registry) and /status (run "
+                         "identity + live rounds_completed/sim_eta_s "
+                         "gauges, plus the RunReport when supervised) — "
+                         "docs/OBSERVABILITY.md §'Observatory'. 0 binds an "
+                         "ephemeral port; the bound port is printed to "
+                         "stderr. TPU engine only (the gauges are the "
+                         "chunk loop's)")
     ap.add_argument("--config", default="",
                     help="JSON config file; typed flags override its values")
     ap.add_argument("--platform", default="auto",
@@ -347,6 +357,7 @@ def main(argv=None) -> int:
             ("--sweep-chunk" if "sweep_chunk" in typed
              else "config field sweep_chunk",
              cfg.sweep_chunk),
+            ("--serve-port", args.serve_port is not None),
         ] if on]
         if rejected:
             parser.error(f"{', '.join(rejected)}: only valid with "
@@ -370,6 +381,9 @@ def main(argv=None) -> int:
                      "as groundwork — runner.run(group_dir=...) writes "
                      "group subdirectories + a completed-group manifest; "
                      "supervisor-driven grouped resume is a future PR")
+    if args.serve_port is not None and not 0 <= args.serve_port <= 65535:
+        parser.error(f"--serve-port must be in [0, 65535] (0 = ephemeral), "
+                     f"got {args.serve_port}")
     keep = getattr(args, "keep_checkpoints", 2)
     if "keep_checkpoints" in vars(args) and not args.checkpoint:
         parser.error("--keep-checkpoints requires --checkpoint (it is the "
@@ -437,10 +451,15 @@ def main(argv=None) -> int:
     # _execute parks the supervised RunReport (success or give-up) here
     # so the finally below can dump it next to the metrics snapshot.
     report_holder: dict = {}
+    server = None
+    if args.serve_port is not None:
+        server = _start_server(cfg, args, platform_tag, report_holder)
     try:
         return _execute(cfg, args, platform_tag, keep, supervise,
                         report_holder)
     finally:
+        if server is not None:
+            server.close()
         # Written on EVERY exit path — a run that died mid-flight still
         # leaves its partial dispatch/checkpoint data and (when
         # supervised) the per-attempt record: the diagnosis artifacts
@@ -460,6 +479,43 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         finally:
             obs_trace.close()
+
+
+def _start_server(cfg, args, platform_tag: str, report_holder: dict):
+    """--serve-port: the live-introspection endpoint (obs/serve.py),
+    started BEFORE compile/execution so /metrics and /status answer for
+    the whole run, not just the post-warmup stretch. Also stamps the
+    run_info info-metric so a scrape self-identifies its run."""
+    import os
+
+    from .obs import metrics as obs_metrics
+    from .obs import serve as obs_serve
+    obs_metrics.info("run_info").set(
+        protocol=cfg.protocol, engine=cfg.engine, platform=platform_tag)
+    static = {"protocol": cfg.protocol, "engine": cfg.engine,
+              "platform": platform_tag, "n_nodes": cfg.n_nodes,
+              "n_rounds": cfg.n_rounds, "n_sweeps": cfg.n_sweeps,
+              "seed": cfg.seed, "pid": os.getpid()}
+
+    def status():
+        doc = dict(static)
+        rr = report_holder.get("run_report")
+        if rr is not None:
+            doc["run_report"] = rr
+        return doc
+
+    try:
+        server = obs_serve.MetricsServer(args.serve_port, status=status)
+    except OSError as exc:
+        # EADDRINUSE and friends: a clean diagnostic, not a traceback —
+        # and no simulation ran, so nothing is half-done.
+        print(f"serve: cannot bind 127.0.0.1:{args.serve_port}: {exc} "
+              f"(pick another --serve-port, or 0 for an ephemeral one)",
+              file=sys.stderr, flush=True)
+        raise SystemExit(2)
+    print(f"serve: listening on http://127.0.0.1:{server.port} "
+          f"(/metrics, /status)", file=sys.stderr, flush=True)
+    return server
 
 
 def _write_metrics(args, run_report: dict | None,

@@ -15,11 +15,17 @@ Three pillars, all opt-in and all digest-neutral by construction:
     ``RunResult.extras["telemetry"]``. That piece lives in the engines
     and :mod:`consensus_tpu.network.runner`; this package holds only the
     host-side sinks.
+  * :mod:`consensus_tpu.obs.serve`   — live run introspection: a
+    daemon-thread localhost HTTP server (``--serve-port``) exposing the
+    metrics registry as ``/metrics`` (Prometheus text) and run status
+    as ``/status`` (docs/OBSERVABILITY.md §"Observatory"). No server
+    starts until the CLI asks for one; importing costs only stdlib
+    ``http.server``.
 
 Nothing here imports jax at module import time — the trace module
 touches ``jax.profiler`` lazily and only when profiler annotation was
 explicitly requested.
 """
-from . import metrics, timeline, trace  # noqa: F401
+from . import metrics, serve, timeline, trace  # noqa: F401
 
-__all__ = ["metrics", "timeline", "trace"]
+__all__ = ["metrics", "serve", "timeline", "trace"]

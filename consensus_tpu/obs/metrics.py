@@ -22,6 +22,10 @@ Snapshot schema (version 1):
               bucket, observations > bounds[-1]); NON-cumulative, so
               count == sum(counts). The Prometheus rendering converts
               to cumulative le-buckets with the trailing +Inf.
+  info      : {"type": "info", "labels": {k: str}}
+              — run-identity labels (the Prometheus info-metric
+              convention: rendered as `name{k="v",...} 1`, label
+              values escaped per the text exposition format).
 
 Tests (and the benchmark suite, which wants a per-config delta) use
 :func:`reset` to zero the default registry.
@@ -124,6 +128,38 @@ class Histogram:
                 "bounds": list(self.bounds), "counts": list(self.counts)}
 
 
+class Info:
+    """Run-identity labels (Prometheus info-metric convention): a set
+    of string key/value pairs rendered as a constant-1 gauge. Last
+    write wins, like :class:`Gauge`."""
+
+    __slots__ = ("labels",)
+
+    def __init__(self) -> None:
+        self.labels: dict[str, str] = {}
+
+    def set(self, **labels: Any) -> None:
+        if not _PAUSED:
+            self.labels = {k: str(v) for k, v in labels.items()}
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"type": "info", "labels": dict(sorted(self.labels.items()))}
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped or the exposition line is
+    unparseable (the serve endpoint's /metrics hands this text to real
+    scrapers, so 'mostly fine' is not fine)."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _label_str(labels: dict[str, str]) -> str:
+    return ",".join(f'{k}="{escape_label_value(v)}"'
+                    for k, v in sorted(labels.items()))
+
+
 class Registry:
     """Name → metric. Re-requesting a name returns the same instance;
     requesting it as a different type is an error (no silent shadowing)."""
@@ -152,6 +188,9 @@ class Registry:
                   buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
         return self._get(name, Histogram, buckets)
 
+    def info(self, name: str) -> Info:
+        return self._get(name, Info)
+
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()
@@ -165,6 +204,12 @@ class Registry:
         """Prometheus text exposition format (cumulative le-buckets)."""
         out = []
         for name, d in self.snapshot().items():
+            if d["type"] == "info":
+                # Info-metric convention: a constant-1 gauge carrying
+                # run identity in (escaped) labels.
+                out.append(f"# TYPE {name} gauge")
+                out.append(f"{name}{{{_label_str(d['labels'])}}} 1")
+                continue
             out.append(f"# TYPE {name} {d['type']}")
             if d["type"] in ("counter", "gauge"):
                 out.append(f"{name} {d['value']}")
@@ -186,6 +231,7 @@ REGISTRY = Registry()
 counter = REGISTRY.counter
 gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram
+info = REGISTRY.info
 reset = REGISTRY.reset
 snapshot = REGISTRY.snapshot
 to_prometheus = REGISTRY.to_prometheus

@@ -1,0 +1,122 @@
+"""Live run introspection: /metrics + /status over localhost HTTP.
+
+The first brick of sweep-as-a-service (ROADMAP): a stdlib
+``http.server`` thread the CLIs start behind ``--serve-port``, serving
+
+  ``/metrics``  Prometheus text exposition of the process metrics
+                registry (the same rendering ``--metrics-out x.prom``
+                snapshots, plus the flight/timeline gauges as they
+                land), scrapeable mid-run;
+  ``/status``   one JSON object: the run's static identity (protocol,
+                engine, shape, pid) merged with the live
+                ``rounds_completed`` / ``sim_eta_s`` gauges the runner
+                updates per chunk, plus the supervised RunReport once
+                one exists.
+
+Entirely OFF the hot path: the chunk loop only touches the gauges it
+already updates; each request reads a locked registry snapshot on the
+server thread. Binds 127.0.0.1 only (introspection, not a public
+surface); port 0 asks the OS for an ephemeral port — the bound port is
+in ``MetricsServer.port`` and on the stderr banner the CLI prints.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+from . import metrics
+
+StatusFn = Callable[[], "dict[str, Any]"]
+
+
+class _QuietServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-request error hook doesn't spam: a
+    scraper disconnecting mid-response (curl timeout, a cancelled
+    Prometheus scrape) raises BrokenPipeError out of the handler, and
+    socketserver's default ``handle_error`` prints a full traceback to
+    stderr — the same noise ``log_message`` is silenced for.
+    Introspection must never be louder than the run; but a GENUINE
+    handler bug (a non-serializable status value, say) keeps one
+    concise diagnostic line — an error channel, not a traceback."""
+
+    def handle_error(self, request: Any, client_address: Any) -> None:
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            return  # the scraper went away; nothing is wrong here
+        print(f"serve: request error: {type(exc).__name__}: {exc}",
+              file=sys.stderr, flush=True)
+
+
+class MetricsServer:
+    """A daemon-thread HTTP server over the process metrics registry.
+
+    ``status`` supplies the /status payload's run-identity fields; the
+    live gauge values are merged in at request time so the endpoint
+    never goes through the run loop. Use as a context manager or call
+    :meth:`close`.
+    """
+
+    def __init__(self, port: int = 0, status: StatusFn | None = None,
+                 host: str = "127.0.0.1") -> None:
+        self._status = status
+        self._t0 = time.time()
+        handler = self._make_handler()
+        self._httpd = _QuietServer((host, port), handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-server",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return int(self._httpd.server_address[1])
+
+    def status_payload(self) -> dict[str, Any]:
+        doc: dict[str, Any] = dict(self._status()) if self._status else {}
+        snap = metrics.snapshot()
+        for gauge in ("rounds_completed", "sim_eta_s"):
+            doc[gauge] = snap.get(gauge, {}).get("value", 0)
+        doc["uptime_s"] = round(time.time() - self._t0, 3)
+        return doc
+
+    def _make_handler(self) -> type:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if self.path == "/metrics":
+                    body = metrics.to_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path == "/status":
+                    body = (json.dumps(server.status_payload(), indent=2)
+                            + "\n").encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404, "unknown path "
+                                    "(try /metrics or /status)")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                pass  # scrapes must not spam the run's stderr
+
+        return Handler
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

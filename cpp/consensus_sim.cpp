@@ -62,6 +62,13 @@ struct Args {
   // the Python front door before strict parsing; a cpu-engine scenario
   // is rejected below rather than silently ignored.
   std::string scenario;
+  // --serve-port: live /metrics + /status introspection, served by the
+  // Python process's metrics registry — `--engine tpu --serve-port P`
+  // re-execs the Python front door before strict parsing; the scalar
+  // oracle has no registry to serve, so a cpu-engine request is
+  // rejected below rather than silently ignored.
+  int serve_port = 0;
+  bool serve_port_given = false;  // -1 must not double as "absent"
   bool nodes_given = false;
 };
 
@@ -91,7 +98,8 @@ uint32_t prob_threshold_u32(double p) {
       "  [--oracle-delivery auto|dense|edge]  (cpu engine; digests equal)\n"
       "  [--n-proposers P]\n"
       "  [--candidates C] [--producers K] [--epoch-len E] [--out FILE]\n"
-      "  [--scenario NAME]   (scripted attack + timeline assertions; tpu)\n",
+      "  [--scenario NAME]   (scripted attack + timeline assertions; tpu)\n"
+      "  [--serve-port P]    (live /metrics + /status introspection; tpu)\n",
       argv0);
   std::exit(code);
 }
@@ -138,6 +146,7 @@ Args parse(int argc, char** argv) {
     else if (k == "--epoch-len") a.epoch_len = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--out") a.out_path = need(k.c_str());
     else if (k == "--scenario") a.scenario = need(k.c_str());
+    else if (k == "--serve-port") { a.serve_port = int(std::strtol(need(k.c_str()), nullptr, 10)); a.serve_port_given = true; }
     else if (k == "--help" || k == "-h") usage(argv[0], 0);
     else { std::fprintf(stderr, "unknown flag %s\n", k.c_str()); usage(argv[0], 2); }
   }
@@ -168,6 +177,14 @@ Args parse(int argc, char** argv) {
                  "flight-recorder timeline assertions, which only the TPU "
                  "engine records — run with --engine tpu (this front door "
                  "re-execs the Python CLI for it)\n");
+    std::exit(2);
+  }
+  if (a.serve_port_given) {
+    std::fprintf(stderr,
+                 "--serve-port serves the Python process's live metrics "
+                 "registry (/metrics, /status); the scalar oracle records "
+                 "none — run with --engine tpu (this front door re-execs "
+                 "the Python CLI for it)\n");
     std::exit(2);
   }
   if (a.miss_rate > 0 && a.protocol != "dpos") {

@@ -1,0 +1,3 @@
+"""Fixture producer: 'rogue_card_field' is missing from the validator's
+COST_CARD_FIELDS, whose 'stale_card_field' no producer emits."""
+CARD_FIELDS = ("schema", "rogue_card_field")

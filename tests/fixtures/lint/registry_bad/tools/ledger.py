@@ -1,0 +1,3 @@
+"""Fixture producer: 'rogue_row_field' is missing from the validator's
+LEDGER_ROW_FIELDS, whose 'stale_row_field' no producer emits."""
+ROW_FIELDS = ("source", "rogue_row_field")

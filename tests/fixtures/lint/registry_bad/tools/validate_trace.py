@@ -7,3 +7,12 @@ TELEMETRY_COUNTERS = frozenset({
 LATENCY_HISTOGRAMS = frozenset({
     "good_hist", "stale_hist",
 })
+# Observatory field registries, seeded with the same two-way drift:
+# each has a stale entry no producer emits, and each producer declares
+# a rogue field missing here.
+COST_CARD_FIELDS = frozenset({
+    "schema", "stale_card_field",
+})
+LEDGER_ROW_FIELDS = frozenset({
+    "source", "stale_row_field",
+})

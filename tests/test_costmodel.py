@@ -1,0 +1,179 @@
+"""Observatory layer 1: the compiled cost model (tools/costmodel).
+
+Mirrors tests/test_hlocheck.py's pattern for the sibling artifact set:
+
+  1. CLEAN REPO — every hlocheck-registered target has a committed,
+     schema-valid cost card, and (same toolchain) what this compiler
+     lowers today matches it;
+  2. SEMANTICS — a card's cost/roofline blocks are internally
+     consistent, the collective census reads off the committed mesh
+     fingerprints at the 4-byte dtype bound, drift is detected
+     field-by-field;
+  3. SCALING — the 500k/1M node-sharded projection covers the declared
+     grid, scales linearly, and answers the 1M-node HBM-fit question.
+"""
+import copy
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from tools import validate_trace  # noqa: E402
+from tools.costmodel import model  # noqa: E402
+from tools.costmodel.__main__ import run_checks  # noqa: E402
+from tools.hlocheck import registry  # noqa: E402
+
+TARGET_NAMES = {t.name for t in registry.targets()}
+
+
+# --- 1. clean repo -----------------------------------------------------------
+
+def test_every_registered_target_has_a_committed_card():
+    committed = {p.stem for p in model.COSTCARD_DIR.glob("*.json")}
+    assert committed == TARGET_NAMES, (
+        f"cost cards and hlocheck registry drifted: missing cards "
+        f"{sorted(TARGET_NAMES - committed)}, orphaned cards "
+        f"{sorted(committed - TARGET_NAMES)} — run "
+        f"`python -m tools.costmodel --update`")
+
+
+def test_committed_cards_validate_against_schema():
+    for name in sorted(TARGET_NAMES):
+        errs = validate_trace.validate_costcard(model.path_for(name))
+        assert not errs, errs
+
+
+@pytest.mark.skipif(
+    os.environ.get("CONSENSUS_COST_LAYER_RAN") == "1",
+    reason="the check.py costcheck layer already ran the full gate in "
+           "this invocation (tools/check.py sets the env var)")
+def test_costcheck_gate_is_clean():
+    assert run_checks() == 0
+
+
+# --- 2. card semantics -------------------------------------------------------
+
+def _cheap_card():
+    return model.build_card(registry.target("pbft-1k-dense"))
+
+
+def test_card_internal_consistency():
+    card = _cheap_card()
+    assert tuple(card) == model.CARD_FIELDS
+    c, roof = card["cost"], card["roofline"]
+    assert c["flops_per_round"] > 0 and c["bytes_per_round"] > 0
+    assert c["arithmetic_intensity"] == pytest.approx(
+        c["flops_per_round"] / c["bytes_per_round"])
+    cfg = registry.target("pbft-1k-dense").cfg
+    assert c["steps_per_round"] == cfg.n_sweeps * cfg.n_nodes
+    assert roof["predicted_steps_per_sec"] == pytest.approx(
+        c["steps_per_round"] / roof["predicted_round_s"])
+    # Integer VPU kernels sit far under the bf16 MXU peak: every
+    # registered config must be bandwidth-bound or the model is wrong.
+    assert roof["bound"] == "bandwidth"
+
+
+def test_card_matches_committed_on_same_toolchain():
+    committed = model.load("pbft-1k-dense")
+    assert committed is not None
+    if not model.same_toolchain(committed):
+        pytest.skip("different jax/jaxlib than the committed card "
+                    "(cross-toolchain drift only warns, like "
+                    "fingerprints)")
+    assert model.diff(committed, _cheap_card()) == []
+
+
+def test_diff_detects_field_level_drift():
+    card = model.load("raft-100k")
+    tampered = copy.deepcopy(card)
+    tampered["cost"]["bytes_per_round"] *= 2
+    tampered["roofline"]["bound"] = "compute"
+    lines = model.diff(card, tampered)
+    assert any("cost.bytes_per_round" in ln for ln in lines)
+    assert any("roofline.bound" in ln for ln in lines)
+    assert model.diff(card, copy.deepcopy(card)) == []
+
+
+def test_collective_census_reads_fingerprints_at_dtype_bound():
+    card = model.load("raft-100k")
+    census = card["collectives"]["node2x4"]["collectives"]
+    assert "all-reduce" in census  # the quorum psum crosses the mesh
+    for op, c in census.items():
+        assert c["max_bytes"] == c["max_elems"] * model.MAX_ELEM_BYTES, op
+    # Sweep-only meshes are collective-free by contract.
+    assert card["collectives"]["sweep8"]["collectives"] == {}
+
+
+def test_fsweep_card_counts_real_nodes_only():
+    card = model.load("pbft-100k-bcast-fsweep")
+    tgt = registry.target("pbft-100k-bcast-fsweep")
+    want = tgt.cfg.n_sweeps * sum(3 * f + 1 for f in tgt.fsweep)
+    assert card["cost"]["steps_per_round"] == want
+
+
+# --- 3. scaling projection ---------------------------------------------------
+
+def test_scale_rows_cover_grid_and_scale_linearly():
+    rows = model.scale_rows()
+    keys = {(r["name"], r["n_nodes"], r["devices"]) for r in rows}
+    assert keys == {(n, N, d) for n in model.SCALE_TARGETS
+                    for N in model.SCALE_NS for d in model.SCALE_DEVICES}
+    by = {(r["name"], r["n_nodes"], r["devices"]): r for r in rows}
+    r100, r1m = by[("raft-100k", 100_000, 1)], by[("raft-100k",
+                                                   1_000_000, 1)]
+    # Bandwidth-bound O(N) rounds: per-device bytes scale ~linearly and
+    # steps/s is N-invariant at fixed D.
+    assert r1m["bytes_per_round_per_device"] == pytest.approx(
+        10 * r100["bytes_per_round_per_device"], rel=0.01)
+    assert r1m["predicted_steps_per_sec"] == pytest.approx(
+        r100["predicted_steps_per_sec"], rel=0.01)
+    # The ROADMAP question this table answers: a 1M-node raft-sparse
+    # carry fits ONE chip's HBM — the mesh buys wall time, not
+    # feasibility.
+    assert r1m["fits_hbm"] and r1m["carry_bytes"] < 16 * 1024**3
+    # Sharding helps: 8 devices beat 1 at every N.
+    for name in model.SCALE_TARGETS:
+        for N in model.SCALE_NS:
+            assert (by[(name, N, 8)]["predicted_steps_per_sec"]
+                    > by[(name, N, 1)]["predicted_steps_per_sec"])
+
+
+def test_scale_markdown_renders_every_row():
+    rows = model.scale_rows()
+    md = model.scale_markdown(rows)
+    assert md.count("\n") == len(rows) + 1  # header + divider + rows
+
+
+def test_committed_scale_table_matches_cards():
+    # Drift gate for the docs/SCALE.md marker section, like
+    # test_committed_ledger_is_valid_and_regenerable: the table is a
+    # pure function of the committed cost cards, so regenerating the
+    # cards without `--scale --update` must fail here, not silently
+    # publish stale numbers.
+    from tools.costmodel.__main__ import SCALE_BEGIN, SCALE_DOC, SCALE_END
+    text = SCALE_DOC.read_text()
+    committed = text.split(SCALE_BEGIN, 1)[1].split(SCALE_END, 1)[0]
+    assert committed.strip() == model.scale_markdown(
+        model.scale_rows()).strip(), (
+        "docs/SCALE.md projection table is stale — run "
+        "`python -m tools.costmodel --scale --update`")
+
+
+# --- validator seeded violations --------------------------------------------
+
+def test_validator_flags_costcard_drift(tmp_path):
+    card = model.load("dpos-100k")
+    bad = copy.deepcopy(card)
+    bad["surprise"] = 1
+    del bad["roofline"]
+    bad["cost"]["arithmetic_intensity"] = 999.0
+    p = tmp_path / "bad_card.json"
+    p.write_text(json.dumps(bad))
+    errs = validate_trace.validate_costcard(p)
+    assert any("surprise" in e for e in errs)
+    assert any("missing key 'roofline'" in e for e in errs)
+    assert any("arithmetic_intensity" in e for e in errs)

@@ -1,0 +1,192 @@
+"""Observatory layer 2: the cross-run perf ledger (tools/ledger.py).
+
+Stdlib-fast (no jax): the ledger folds committed history — driver
+BENCH captures, multichip dry runs, benchmarks/RESULTS.json — plus the
+cost cards into benchmarks/LEDGER.json. Pins: every measured RESULTS
+row carries a measured-vs-predicted ratio, stale_timing markers
+propagate into rows (not just a startup stderr line), instrument
+classes never cross-compare, the noise-banded verdict fires on real
+regressions only, and the schema tripwire rejects drift.
+"""
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from tools import ledger, validate_trace  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _doc():
+    return ledger.build(REPO)
+
+
+def test_every_results_tpu_row_has_measured_vs_predicted():
+    doc = _doc()
+    tpu = [r for r in doc["rows"] if r["kind"] == "results-tpu"]
+    assert tpu, "RESULTS.json produced no measured rows"
+    for r in tpu:
+        assert (r["predicted_steps_per_sec"] or 0) > 0, r["name"]
+        assert (r["measured_vs_predicted"] or 0) > 0, r["name"]
+    # The padded f-ladder row is costed by the fsweep card (CARD_FOR).
+    assert any(r["name"] == "pbft-fsweep-one-program" for r in tpu)
+
+
+def test_oracle_rows_form_their_own_series():
+    doc = _doc()
+    oracle = [r for r in doc["rows"] if r["kind"] == "results-oracle"]
+    assert oracle
+    for r in oracle:
+        assert r["predicted_steps_per_sec"] is None  # no device roofline
+        assert r["platform"] == "cpu-oracle"
+    # A single-core baseline must never read as a TPU regression:
+    # raft-5node has exactly one tpu measurement (RESULTS) — an oracle
+    # row leaking into the class would make it a 2-point series whose
+    # 0.69x 'latest' reds the build.
+    assert doc["series"]["raft-5node@tpu"]["n_points"] == 1
+    oracle_sps = {r["steps_per_sec"] for r in oracle}
+    for key, s in doc["series"].items():
+        if key.endswith("@tpu"):
+            assert not oracle_sps & {p["steps_per_sec"]
+                                     for p in s["points"]}, key
+    assert "raft-100k@oracle" in doc["series"]
+    assert doc["series"]["raft-100k@tpu"]["n_points"] >= 2  # bench + RESULTS
+
+
+def test_stale_timing_markers_propagate_into_rows():
+    doc = _doc()
+    stale = [r for r in doc["rows"] if r["stale"]]
+    assert [r["name"] for r in stale] == ["pbft-100k-bcast"]
+    assert "sort-diet" in stale[0]["stale"]
+    assert doc["stale_rows"] and doc["stale_rows"][0]["name"] == \
+        "pbft-100k-bcast"
+
+
+def test_committed_history_has_no_regressions():
+    doc = _doc()
+    assert doc["regressions"] == [], doc["regressions"]
+    # The known-stale pbft row reads stale-latest, never regression.
+    verd = doc["series"]["pbft-100k-bcast@tpu"]["verdict"]
+    assert verd in ("stale-latest", "single-point")
+
+
+def test_series_verdicts_synthetic():
+    def row(name, sps, plat="tpu", stale=None, seq=1, ok=True):
+        return ledger._row(source="s", kind="driver-bench", name=name,
+                           seq=seq, platform=plat, steps_per_sec=sps,
+                           stale=stale, ok=ok)
+
+    s = ledger.build_series([row("a", 100e6), row("a", 100e6 * 0.9,
+                                                  seq=2)])
+    assert s["a@tpu"]["verdict"] == "ok"  # within the ±15% band
+    s = ledger.build_series([row("a", 100e6), row("a", 100e6 * 0.7,
+                                                  seq=2)])
+    assert s["a@tpu"]["verdict"] == "regression"
+    s = ledger.build_series([row("a", 100e6),
+                             row("a", 60e6, stale="pre-fix row", seq=2)])
+    assert s["a@tpu"]["verdict"] == "stale-latest"
+    # ...and a stale point never becomes the BASELINE either: a pre-fix
+    # timing that overstated steps/s must not verdict the first fresh
+    # correct measurement a regression.
+    s = ledger.build_series([row("a", 100e6, stale="pre-fix row"),
+                             row("a", 10e6, seq=2)])
+    assert s["a@tpu"]["verdict"] == "single-point"
+    s = ledger.build_series([row("a", 100e6, stale="pre-fix row"),
+                             row("a", 10e6, seq=2),
+                             row("a", 9.5e6, seq=3)])
+    assert s["a@tpu"]["verdict"] == "ok" and s["a@tpu"]["best_prior"] == 10e6
+    s = ledger.build_series([row("a", 100e6)])
+    assert s["a@tpu"]["verdict"] == "single-point"
+    # ok=false rows (failed/degenerate runs) never drive a verdict —
+    # neither as a bogus 'latest' nor as an inflated 'best prior'.
+    s = ledger.build_series([row("a", 100e6),
+                             row("a", 1e6, seq=2, ok=False)])
+    assert s["a@tpu"]["verdict"] == "single-point"
+    s = ledger.build_series([row("a", 500e6, ok=False),
+                             row("a", 100e6, seq=2),
+                             row("a", 98e6, seq=3)])
+    assert s["a@tpu"]["verdict"] == "ok"
+    # Chronology beats concatenation order: a FRESH driver capture
+    # (timestamped after the RESULTS artifact) must be the series'
+    # latest point even though results rows enter the row list last —
+    # a 2.8x regression in the newest capture has to fire.
+    results_row = ledger._row(source="benchmarks/RESULTS.json",
+                              kind="results-tpu", name="a",
+                              timestamp=1_785_000_000.0, platform="tpu",
+                              steps_per_sec=100e6, ok=True)
+    fresh = row("a", 36e6, seq=6)
+    fresh["timestamp"] = 1_786_000_000.0
+    s = ledger.build_series([row("a", 90e6, seq=5), fresh, results_row])
+    assert s["a@tpu"]["verdict"] == "regression"
+    assert s["a@tpu"]["latest"] == 36e6
+    assert s["a@tpu"]["best_prior"] == 100e6
+    # Platform classes never cross-compare.
+    s = ledger.build_series([row("a", 100e6), row("a", 1e6, plat="cpu",
+                                                  seq=2)])
+    assert set(s) == {"a@tpu", "a@cpu"}
+    assert all(v["verdict"] == "single-point" for v in s.values())
+
+
+def test_bench_trajectory_block_ingested_directly(tmp_path):
+    (tmp_path / "benchmarks").mkdir()
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps({
+        "n": 9, "cmd": "python bench.py", "rc": 0,
+        "tail": "irrelevant free text",
+        "parsed": {"metric": "raft-100000node-64round-cap8 "
+                             "node-round-steps/sec [tpu]",
+                   "value": 58.0e6, "unit": "steps/sec",
+                   "vs_baseline": 5.8,
+                   "trajectory": {"schema": 1, "timestamp": 1785e6,
+                                  "platform": "tpu", "protocol": "raft",
+                                  "nodes": 100_000, "rounds": 64,
+                                  "sweeps": 8, "max_active": 8,
+                                  "steps": 51_200_000, "wall_s": 0.883,
+                                  "repeats": 3, "max_committed": 61}}}))
+    doc = ledger.build(tmp_path)
+    [row] = doc["rows"]
+    assert row["name"] == "raft-100k"  # flagship shape, from the block
+    assert row["wall_s"] == 0.883 and row["steps"] == 51_200_000
+    assert row["timestamp"] == 1785e6 and row["ok"] is True
+
+
+def test_failed_driver_round_keeps_its_hole_visible(tmp_path):
+    (tmp_path / "benchmarks").mkdir()
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "python bench.py", "rc": 1,
+         "tail": "Traceback ...", "parsed": None}))
+    doc = ledger.build(tmp_path)
+    [row] = doc["rows"]
+    assert row["ok"] is False and "no parseable" in row["notes"]
+
+
+def test_committed_ledger_is_valid_and_regenerable(tmp_path):
+    committed = REPO / "benchmarks" / "LEDGER.json"
+    errs = validate_trace.validate_ledger(committed)
+    assert not errs, errs
+    out = tmp_path / "LEDGER.json"
+    assert ledger.main(["--repo", str(REPO), "--out", str(out),
+                        "--check", "--quiet"]) == 0
+    assert not validate_trace.validate_ledger(out)
+    # Drift gate, like the cost cards/fingerprints: the build is a pure
+    # function of its inputs (no wall clock), so the committed artifact
+    # must equal a fresh regeneration — a new BENCH round or RESULTS
+    # edit without `make ledger` fails here, not in a reader's hands.
+    assert json.loads(out.read_text()) == json.loads(
+        committed.read_text()), \
+        "committed benchmarks/LEDGER.json is stale — run `make ledger`"
+
+
+def test_validator_flags_ledger_drift(tmp_path):
+    doc = ledger.build(REPO)
+    doc["rows"][0]["surprise"] = 1
+    for r in doc["rows"]:
+        if r["kind"] == "results-tpu":
+            r["measured_vs_predicted"] = None
+            break
+    p = tmp_path / "bad_ledger.json"
+    p.write_text(json.dumps(doc))
+    errs = validate_trace.validate_ledger(p)
+    assert any("surprise" in e for e in errs)
+    assert any("measured_vs_predicted" in e for e in errs)

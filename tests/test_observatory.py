@@ -1,0 +1,182 @@
+"""Observatory layer 3: Prometheus rendering edge cases + the live
+/metrics + /status endpoint (consensus_tpu/obs/serve.py).
+
+The text a real scraper ingests must be exactly right — cumulative
+le-buckets, escaped label values, last-write-wins gauges — and the
+acceptance path is end-to-end: a subprocess CLI run under
+``--serve-port`` must answer both endpoints MID-RUN on the CPU
+backend.
+"""
+import json
+import re
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consensus_tpu.obs import metrics, serve
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# --- Prometheus text rendering edge cases ------------------------------------
+
+def test_histogram_buckets_render_cumulative_with_inf():
+    h = metrics.histogram("t_s", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 0.5, 1.5, 3.0, 100.0):   # 100.0 -> overflow bucket
+        h.observe(v)
+    text = metrics.to_prometheus()
+    lines = [ln for ln in text.splitlines() if ln.startswith("t_s_bucket")]
+    # Non-cumulative counts are (2, 1, 1, 1); the rendering must be
+    # the running sum, with +Inf == count (the overflow bucket lives
+    # ONLY inside +Inf — a scraper summing le-buckets must not lose it).
+    assert lines == ['t_s_bucket{le="1.0"} 2', 't_s_bucket{le="2.0"} 3',
+                     't_s_bucket{le="4.0"} 4', 't_s_bucket{le="+Inf"} 5']
+    assert "t_s_count 5" in text
+    assert h.count == sum(h.counts)  # snapshot stays non-cumulative
+
+
+def test_gauge_overwrite_renders_last_write_only():
+    g = metrics.gauge("rounds_completed")
+    g.set(16)
+    g.set(64)
+    text = metrics.to_prometheus()
+    assert "rounds_completed 64" in text
+    assert "rounds_completed 16" not in text
+
+
+def test_label_value_escaping():
+    assert metrics.escape_label_value('a"b') == 'a\\"b'
+    assert metrics.escape_label_value("a\\b") == "a\\\\b"
+    assert metrics.escape_label_value("a\nb") == "a\\nb"
+    metrics.info("run_info").set(platform='tpu "v5e"\ntunnel',
+                                 protocol="raft")
+    text = metrics.to_prometheus()
+    [line] = [ln for ln in text.splitlines()
+              if ln.startswith("run_info{")]
+    assert line == ('run_info{platform="tpu \\"v5e\\"\\ntunnel",'
+                    'protocol="raft"} 1')
+    assert "\n tunnel" not in text  # no raw newline inside a label
+
+
+def test_info_metric_snapshot_and_type_collision():
+    metrics.info("run_info").set(engine="tpu")
+    snap = metrics.snapshot()
+    assert snap["run_info"] == {"type": "info", "labels": {"engine": "tpu"}}
+    with pytest.raises(TypeError):
+        metrics.counter("run_info")
+
+
+def test_info_metric_validates(tmp_path):
+    import pathlib
+    import sys as _sys
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from tools import validate_trace
+    metrics.info("run_info").set(protocol="raft")
+    metrics.counter("x_total").inc()
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps({"version": 1, "metrics": metrics.snapshot()}))
+    assert not validate_trace.validate_metrics(p)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"version": 1, "metrics": {
+        "run_info": {"type": "info", "labels": {"k": 3}}}}))
+    assert validate_trace.validate_metrics(bad)
+
+
+# --- the server, in-process --------------------------------------------------
+
+def _get(port: int, path: str):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10)
+
+
+def test_metrics_server_serves_registry_and_status():
+    metrics.counter("checkpoint_saves_total").inc(2)
+    metrics.gauge("rounds_completed").set(32)
+    metrics.gauge("sim_eta_s").set(1.5)
+    with serve.MetricsServer(0, status=lambda: {"protocol": "raft",
+                                                "n_rounds": 64}) as srv:
+        body = _get(srv.port, "/metrics").read().decode()
+        assert "# TYPE checkpoint_saves_total counter" in body
+        assert "checkpoint_saves_total 2" in body
+        st = json.load(_get(srv.port, "/status"))
+        assert st["protocol"] == "raft" and st["n_rounds"] == 64
+        assert st["rounds_completed"] == 32 and st["sim_eta_s"] == 1.5
+        assert st["uptime_s"] >= 0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/nope")
+        assert ei.value.code == 404
+    # Closed: the port no longer answers.
+    with pytest.raises(urllib.error.URLError):
+        _get(srv.port, "/metrics")
+
+
+def test_metrics_server_status_without_callable():
+    with serve.MetricsServer(0) as srv:
+        st = json.load(_get(srv.port, "/status"))
+        assert "rounds_completed" in st and "sim_eta_s" in st
+
+
+def test_scraper_disconnect_is_silent(capfd):
+    import socket
+    metrics.histogram("h_s").observe(0.01)
+    with serve.MetricsServer(0) as srv:
+        # A scraper that sends the request and slams the socket shut:
+        # the handler's write hits a dead pipe. The run's stderr must
+        # stay clean — no socketserver traceback spam.
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     b"\x01\x00\x00\x00\x00\x00\x00\x00")  # RST on close
+        s.close()
+        # A follow-up well-behaved scrape proves the server survived.
+        assert "h_s_count" in _get(srv.port, "/metrics").read().decode()
+    out, err = capfd.readouterr()
+    assert "Traceback" not in err and "Exception occurred" not in err
+
+
+# --- acceptance: subprocess CLI run, scraped mid-run -------------------------
+
+def test_cli_serve_port_answers_mid_run(tmp_path):
+    """A real `--serve-port 0` run on the CPU backend: read the bound
+    port off the stderr banner, scrape /metrics and /status while the
+    subprocess is still executing (the server starts before
+    compile, so the window covers warmup + every chunk), then let the
+    run finish and check its report — the Observatory acceptance
+    path."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "consensus_tpu", "--protocol", "raft",
+         "--nodes", "32", "--rounds", "256", "--scan-chunk", "16",
+         "--sweeps", "2", "--log-capacity", "32", "--max-entries", "16",
+         "--engine", "tpu", "--platform", "cpu", "--serve-port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        for line in proc.stderr:
+            m = re.search(r"serve: listening on http://127\.0\.0\.1:(\d+)",
+                          line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "no serve banner on stderr"
+        assert proc.poll() is None, "run finished before the scrape"
+
+        body = _get(port, "/metrics").read().decode()
+        assert 'run_info{' in body and 'protocol="raft"' in body
+        st = json.load(_get(port, "/status"))
+        assert st["protocol"] == "raft" and st["engine"] == "tpu"
+        assert st["n_rounds"] == 256 and st["pid"] == proc.pid
+        assert isinstance(st["rounds_completed"], (int, float))
+        assert st["rounds_completed"] <= 256
+    finally:
+        out, err = proc.communicate(timeout=240)
+    assert proc.returncode == 0, err
+    report = json.loads(out)
+    assert report["protocol"] == "raft" and len(report["digest"]) == 64

@@ -83,6 +83,16 @@ def test_registry_catches_telemetry_and_crash_split_drift():
     assert "recovery-reset fields ['timer']" in msgs     # declared persistent
 
 
+def test_registry_catches_observatory_field_drift():
+    # The cost-card / ledger exactly-these-keys registries drift both
+    # ways like the telemetry counters: producer field missing from the
+    # validator, validator entry emitted by no producer.
+    msgs = _messages("registry_bad", "registry")
+    assert "'rogue_card_field'" in msgs and "'stale_card_field'" in msgs
+    assert "'rogue_row_field'" in msgs and "'stale_row_field'" in msgs
+    assert "stale registry entry" in msgs
+
+
 def test_cli_catches_unreachable_field_and_forked_flags():
     msgs = _messages("cli_bad", "cli")
     assert "Config.new_knob is unreachable from the Python CLI" in msgs

@@ -1,5 +1,6 @@
 #!/usr/bin/env python3
-"""The one-command gate: lint + hlo + ruff + mypy + clang-tidy + tier-1.
+"""The one-command gate: lint + hlo + costcheck + ruff + mypy +
+clang-tidy + tier-1.
 
     python tools/check.py [--skip-tests] [--only LAYER ...]
     make check                  # the same thing
@@ -11,6 +12,9 @@ Layers (docs/STATIC_ANALYSIS.md):
   hlo    — tools/hlocheck, the COMPILED-program contracts (collective
            family, sort budgets, dtype widening, host boundary, carry
            donation + fingerprints; CPU lowering only)      [gated]
+  costcheck — tools/costmodel, the compiled COST model (XLA
+           cost_analysis per registered config vs the committed cost
+           cards under benchmarks/parts/costcards/)         [gated]
   ruff   — generic Python lint (pyproject.toml)        [gated]
   mypy   — typed-perimeter type check (pyproject.toml) [gated]
   tidy   — clang-tidy over cpp/ (`make -C cpp tidy`)   [gated]
@@ -69,6 +73,21 @@ def layer_hlo(_: argparse.Namespace) -> str:
     return "ok"
 
 
+def layer_costcheck(_: argparse.Namespace) -> str:
+    # tools/costmodel self-gates like hlocheck (loud SKIP, exit 0, when
+    # jax is missing) and forces the CPU backend itself. Runs AFTER the
+    # hlo layer: the cards' collective censuses read the committed
+    # fingerprints, so a fingerprint failure should fail as itself, not
+    # as mysterious cost drift.
+    if _run([sys.executable, "-m", "tools.costmodel"]):
+        return "FAIL"
+    # Like the hlo layer: tell tier-1's in-process mirror test the full
+    # costcheck gate already ran in THIS invocation so it skips the
+    # re-lowering.
+    os.environ["CONSENSUS_COST_LAYER_RAN"] = "1"
+    return "ok"
+
+
 def layer_ruff(_: argparse.Namespace) -> str:
     if not _have("ruff"):
         return "SKIP (ruff not installed)"
@@ -120,7 +139,8 @@ def layer_tests(args: argparse.Namespace) -> str:
     return "FAIL" if _run(TIER1, env=env) else "ok"
 
 
-LAYERS = {"lint": layer_lint, "hlo": layer_hlo, "ruff": layer_ruff,
+LAYERS = {"lint": layer_lint, "hlo": layer_hlo,
+          "costcheck": layer_costcheck, "ruff": layer_ruff,
           "mypy": layer_mypy, "tidy": layer_tidy,
           "scenarios": layer_scenarios, "tests": layer_tests}
 

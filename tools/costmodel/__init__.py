@@ -1,0 +1,28 @@
+"""Compiled cost model — the Observatory's analytic layer.
+
+``tools/hlocheck`` pins WHAT the compiled programs are (op classes,
+collective families, budgets); this package pins what they COST: every
+hlocheck-registered (engine × flagship shape × mesh) config is lowered
+through the production ``runner._chunk_jit`` (trace time only, CPU
+backend, no flagship buffer allocated) and XLA's ``cost_analysis()`` is
+extracted into a committed per-config **cost card** —
+
+  * FLOPs and bytes accessed per round program,
+  * per-round arithmetic intensity (FLOPs / byte),
+  * a roofline prediction of steps/s against the v5e peaks the
+    benchmark suite already uses (``run_benchmarks.HBM_PEAK_GBPS``),
+  * the node-sharded collective byte census per device (read off the
+    committed hlocheck fingerprints — both artifacts drift-gate
+    together).
+
+Cards live next to the fingerprints
+(``benchmarks/parts/costcards/<target>.json``) and are drift-checked by
+``make check``'s ``costcheck`` layer under the same tolerance policy as
+fingerprints: same-toolchain drift is a code change (fails; rerun with
+``--update`` if intentional), cross-toolchain drift warns.
+
+``--scale`` additionally projects the node-sharded configs to
+N = 500k / 1M (the ROADMAP's no-tunnel scaling fallback) — see
+``docs/SCALE.md`` §"Predicted node-sharded scaling".
+"""
+from __future__ import annotations

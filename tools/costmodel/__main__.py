@@ -1,0 +1,160 @@
+"""costcheck: the compiled cost model's drift gate + card generator.
+
+    python -m tools.costmodel [--update] [--only NAME ...] [--list]
+    python -m tools.costmodel --scale [--update]
+
+Default mode recomputes every hlocheck-registered target's cost card on
+the CPU backend and compares against the committed cards under
+``benchmarks/parts/costcards/`` — same tolerance policy as the
+fingerprints (same-toolchain drift fails, cross-toolchain drift warns
+loudly), exit nonzero on any same-toolchain drift or missing card.
+``--update`` regenerates the cards. ``--scale`` prints the predicted
+node-sharded scaling table (N = 500k/1M) and with ``--update`` rewrites
+the marked section of docs/SCALE.md. SKIPs loudly (exit 0) when jax is
+missing, mirroring tools/check.py's gated-layer convention.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+import time
+
+SCALE_DOC = pathlib.Path(__file__).resolve().parents[2] / "docs" / "SCALE.md"
+SCALE_BEGIN = "<!-- costmodel:scale:begin -->"
+SCALE_END = "<!-- costmodel:scale:end -->"
+
+
+def _setup_platform() -> None:
+    """CPU backend + 8 virtual devices BEFORE the first jax import
+    (mirrors tools/hlocheck.__main__ — lowering must never block on a
+    tunnel)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def run_checks(only: list[str] | None = None, update: bool = False) -> int:
+    import jax
+
+    from tools.hlocheck import registry
+
+    from . import model
+
+    jax.config.update("jax_platforms", "cpu")
+    targets = [t for t in registry.targets() if not only or t.name in only]
+    if only:
+        missing = set(only) - {t.name for t in targets}
+        if missing:
+            print(f"costcheck: unknown target(s) {sorted(missing)}; known: "
+                  f"{[t.name for t in registry.targets()]}", file=sys.stderr)
+            return 2
+
+    rc = 0
+    for tgt in targets:
+        t0 = time.perf_counter()
+        card = model.build_card(tgt)
+        wall = time.perf_counter() - t0
+        c = card["cost"]
+        print(f"costcheck: {tgt.name:24s} [{card['engine']}] "
+              f"({wall:.1f}s, flops/round={c['flops_per_round']:.3g} "
+              f"bytes/round={c['bytes_per_round']:.3g} "
+              f"AI={c['arithmetic_intensity']:.2f} "
+              f"pred={card['roofline']['predicted_steps_per_sec'] / 1e6:.1f}"
+              f"M steps/s [{card['roofline']['bound']}])", flush=True)
+        if update:
+            path = model.save(card)
+            print(f"costcheck: {tgt.name}: cost card written -> {path}",
+                  flush=True)
+            continue
+        committed = model.load(tgt.name)
+        if committed is None:
+            print(f"costcheck: {tgt.name}: FAIL — no committed cost card "
+                  f"({model.path_for(tgt.name)}); run "
+                  f"`python -m tools.costmodel --update` and commit it",
+                  flush=True)
+            rc = 1
+            continue
+        diffs = model.diff(committed, card)
+        if not diffs:
+            continue
+        if model.same_toolchain(committed):
+            print(f"costcheck: {tgt.name}: FAIL — cost drift vs the "
+                  f"committed card (same toolchain ⇒ a code change; rerun "
+                  f"with --update if intentional):", flush=True)
+            rc = 1
+        else:
+            print(f"costcheck: {tgt.name}: WARNING — cost drift under a "
+                  f"DIFFERENT jax/jaxlib; FLOP/byte accounting churns "
+                  f"across compilers. Diff:", flush=True)
+        for line in diffs:
+            print(line, flush=True)
+    print(f"costcheck: {'FAILED' if rc else 'ok'} ({len(targets)} targets)",
+          flush=True)
+    return rc
+
+
+def run_scale(update: bool = False) -> int:
+    from . import model
+    rows = model.scale_rows()
+    table = model.scale_markdown(rows)
+    print(table)
+    if not update:
+        return 0
+    text = SCALE_DOC.read_text()
+    if SCALE_BEGIN not in text or SCALE_END not in text:
+        print(f"costcheck: {SCALE_DOC} has no "
+              f"{SCALE_BEGIN}/{SCALE_END} markers", file=sys.stderr)
+        return 1
+    head, rest = text.split(SCALE_BEGIN, 1)
+    _, tail = rest.split(SCALE_END, 1)
+    SCALE_DOC.write_text(head + SCALE_BEGIN + "\n" + table + "\n"
+                         + SCALE_END + tail)
+    print(f"costcheck: scaling table rewritten in {SCALE_DOC}",
+          file=sys.stderr)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.costmodel",
+        description="Compiled cost model: per-config cost cards + "
+                    "roofline predictions (docs/OBSERVABILITY.md "
+                    "§'Observatory').")
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate the committed cost cards (or, with "
+                         "--scale, rewrite the docs/SCALE.md table)")
+    ap.add_argument("--only", action="append", default=None,
+                    help="check only this target (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered targets")
+    ap.add_argument("--scale", action="store_true",
+                    help="print the predicted node-sharded scaling table "
+                         "(N=500k/1M) from the committed cards")
+    args = ap.parse_args(argv)
+
+    if "jax" not in sys.modules:
+        _setup_platform()
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        print("costcheck: SKIP — jax is not installed; the cost model "
+              "needs the CPU backend to lower against (install jax[cpu] "
+              "to enforce this layer)", file=sys.stderr)
+        return 0
+
+    if args.list:
+        from tools.hlocheck import registry
+        for t in registry.targets():
+            print(t.name)
+        return 0
+    if args.scale:
+        return run_scale(update=args.update)
+    return run_checks(only=args.only, update=args.update)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

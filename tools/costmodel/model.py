@@ -1,0 +1,310 @@
+"""Cost-card construction, drift diffing, and scaling projections.
+
+A cost card is a normalized summary of what one compiled round program
+COSTS, the way a fingerprint summarizes what it IS. Counting note
+(mirrors ``tools/hlocheck/hlo.py``): the chunk program is ONE ``while``
+loop whose body is the round kernel, and ``HloCostAnalysis`` visits
+every instruction once — so module-wide FLOPs/bytes ARE per-round
+figures for the round body, plus a fixed init/epilogue term that the
+scan amortizes away at real round counts.
+
+Roofline: a round cannot finish faster than its bytes at HBM peak nor
+its FLOPs at compute peak, so
+
+    predicted_round_s      = max(bytes / HBM_PEAK, flops / PEAK_FLOPS)
+    predicted_steps_per_sec = steps_per_round / predicted_round_s
+
+an UPPER bound on throughput (real rounds also pay dispatch, sort
+passes re-touching memory, and host sync), which is exactly what makes
+``measured / predicted`` in ``benchmarks/LEDGER.json`` a meaningful
+efficiency ratio in [0, 1]-ish territory.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+from typing import Any
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+COSTCARD_DIR = _REPO / "benchmarks" / "parts" / "costcards"
+
+SCHEMA = 1
+
+# Peaks of the chip the committed measurements ran on (TPU v5 lite /
+# v5e). HBM bandwidth is shared with the benchmark suite's
+# achieved-bandwidth column (one source of truth); the compute peak is
+# the bf16 MXU figure — our kernels are u32/i32 VPU work far below it,
+# so the roofline is bandwidth-bound at every registered config (the
+# card records which bound bind so that claim is checkable, not
+# asserted).
+PEAK_FLOPS = 1.97e14  # v5e bf16 peak, FLOP/s
+
+# Card top-level keys — the exactly-these-keys registry mirrored
+# import-free in tools/validate_trace.py (COST_CARD_FIELDS) and synced
+# both ways by the lint `registry` check, like the telemetry counters.
+CARD_FIELDS = ("schema", "name", "engine", "chunk_rounds", "toolchain",
+               "config", "cost", "roofline", "collectives")
+
+# All-integer state discipline (docs/SPEC.md; the hlocheck dtype
+# contract bans anything wider than 32 bits), so a collective operand
+# element is at most 4 bytes — the census converts the fingerprint's
+# element counts with this worst case.
+MAX_ELEM_BYTES = 4
+
+
+def path_for(name: str) -> pathlib.Path:
+    return COSTCARD_DIR / f"{name}.json"
+
+
+def hbm_peak_gbps() -> float:
+    from benchmarks.run_benchmarks import HBM_PEAK_GBPS
+    return float(HBM_PEAK_GBPS)
+
+
+def _jax_versions() -> dict[str, str]:
+    from tools.hlocheck import fingerprint
+    return fingerprint._jax_versions()
+
+
+def _cost_dict(compiled) -> dict[str, float]:
+    """The module-level ``cost_analysis()`` properties (jax returns one
+    dict per partition; single-partition programs have exactly one).
+    Per-operand breakdown keys (``bytes accessed0{}``) are dropped —
+    they churn with fusion decisions; the module totals are the stable
+    layer."""
+    ca = compiled.cost_analysis()
+    d = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return {k: float(v) for k, v in d.items()
+            if "{" not in k and isinstance(v, (int, float))}
+
+
+def _steps_per_round(target) -> int:
+    """Real node-steps one round of the target's program simulates —
+    padded f-ladder lanes are FLOP waste, not simulated work, mirroring
+    ``run_benchmarks.bench_pbft_fsweep``'s accounting."""
+    cfg = target.cfg
+    if target.fsweep:
+        return cfg.n_sweeps * sum(3 * f + 1 for f in target.fsweep)
+    return cfg.n_sweeps * cfg.n_nodes
+
+
+def _compile_target(target):
+    """Compile the target's production single-device program (the exact
+    one the benchmarks dispatch; f-ladder targets compile the padded
+    one-program sweep) and return the compiled executable."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_tpu.network import runner, simulator
+    from tools.hlocheck import hlo
+
+    if target.fsweep:
+        from consensus_tpu.engines import pbft_sweep
+        return pbft_sweep.fsweep_lower(target.cfg, target.fsweep).compile()
+    eng = simulator.engine_def(target.cfg)
+    carry = hlo.carry_struct(target.cfg, eng)
+    r0 = jax.ShapeDtypeStruct((), jnp.int32)
+    extra = hlo.flight_structs(target.cfg, eng) if target.flight else ()
+    lowered = runner._chunk_jit.lower(
+        target.cfg, eng, hlo.chunk_rounds(target.cfg), carry, r0, *extra,
+        mesh=None)
+    return lowered.compile()
+
+
+def _collective_census(name: str) -> dict[str, Any]:
+    """Per-device collective byte census of the target's meshed
+    variants, read off the COMMITTED hlocheck fingerprint (the two
+    artifacts are committed and drift-gated together, so re-lowering
+    the mesh variants here would only pay the ~seconds again). Element
+    counts convert at the 4-byte worst case the dtype contract
+    guarantees."""
+    from tools.hlocheck import fingerprint
+    doc = fingerprint.load(name)
+    if doc is None:
+        return {}
+    out: dict[str, Any] = {}
+    for key, var in sorted(doc.get("variants", {}).items()):
+        if not var.get("mesh"):
+            continue
+        census = {
+            op: {"count": int(c["count"]),
+                 "max_elems": int(c["max_elems"]),
+                 "max_bytes": int(c["max_elems"]) * MAX_ELEM_BYTES}
+            for op, c in sorted(var.get("collectives", {}).items())}
+        out[key] = {"mesh": var["mesh"], "collectives": census}
+    return out
+
+
+def build_card(target) -> dict[str, Any]:
+    """Lower + compile one registered target and assemble its card."""
+    from consensus_tpu.network import simulator
+    from tools.hlocheck import hlo
+
+    compiled = _compile_target(target)
+    costs = _cost_dict(compiled)
+    flops = costs.get("flops", 0.0)
+    nbytes = costs.get("bytes accessed", 0.0)
+    steps = _steps_per_round(target)
+    bw = hbm_peak_gbps() * 1e9
+    round_s_bw = nbytes / bw if bw else 0.0
+    round_s_fl = flops / PEAK_FLOPS
+    round_s = max(round_s_bw, round_s_fl)
+    card = {
+        "schema": SCHEMA,
+        "name": target.name,
+        "engine": simulator.engine_def(target.cfg).name,
+        "chunk_rounds": (target.cfg.n_rounds if target.fsweep
+                         else hlo.chunk_rounds(target.cfg)),
+        "toolchain": _jax_versions(),
+        "config": json.loads(target.cfg.to_json()),
+        "cost": {
+            "flops_per_round": flops,
+            "bytes_per_round": nbytes,
+            "arithmetic_intensity": flops / nbytes if nbytes else 0.0,
+            "steps_per_round": steps,
+            "bytes_per_step": nbytes / steps if steps else 0.0,
+            "transcendentals_per_round": costs.get("transcendentals", 0.0),
+        },
+        "roofline": {
+            "hbm_peak_gbps": hbm_peak_gbps(),
+            "peak_flops": PEAK_FLOPS,
+            "bound": "compute" if round_s_fl > round_s_bw else "bandwidth",
+            "predicted_round_s": round_s,
+            "predicted_steps_per_sec": steps / round_s if round_s else 0.0,
+        },
+        "collectives": _collective_census(target.name),
+    }
+    assert tuple(card) == CARD_FIELDS, "card keys drifted from CARD_FIELDS"
+    return card
+
+
+def save(card: dict) -> pathlib.Path:
+    path = path_for(card["name"])
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(card, indent=2, sort_keys=True) + "\n")
+    return path
+
+
+def load(name: str) -> dict | None:
+    path = path_for(name)
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def diff(committed: dict, current: dict) -> list[str]:
+    """Field-path diff lines between a committed card and a freshly
+    computed one (empty = no drift), via the fingerprint layer's shared
+    walker — cost-card drift must read exactly like fingerprint drift.
+    The whole card is structure (cost figures have no 'verdict' layer);
+    toolchain tolerance is the caller's policy, same as fingerprints."""
+    from tools.hlocheck.fingerprint import _walk_diff
+    out: list[str] = []
+    _walk_diff("", committed, current, out)
+    return out
+
+
+def same_toolchain(committed: dict) -> bool:
+    from tools.hlocheck import fingerprint
+    return fingerprint.same_toolchain(committed)
+
+
+# --- scaling projection (the ROADMAP no-tunnel fallback) ---------------------
+
+SCALE_NS = (100_000, 500_000, 1_000_000)
+SCALE_DEVICES = (1, 8)
+HBM_PER_DEVICE_BYTES = 16 * 1024**3  # v5e: 16 GB HBM per chip
+
+# Targets whose engines declare a node-sharded claim (hlocheck
+# contracts) — the ones a >1-chip mesh can actually scale on the node
+# axis, and therefore the ones worth projecting past 100k nodes.
+SCALE_TARGETS = ("raft-100k", "dpos-100k")
+
+
+def _scaled_carry_bytes(cfg, n: int) -> int:
+    import dataclasses
+
+    from benchmarks.run_benchmarks import carry_nbytes
+    return carry_nbytes(dataclasses.replace(cfg, n_nodes=n))
+
+
+def _collective_bytes_per_round(card: dict) -> int:
+    """Worst-case per-device collective bytes per round across the
+    card's meshed variants (0 when the engine's claim is collective-free
+    — dpos — or no mesh variant is registered)."""
+    worst = 0
+    for var in card.get("collectives", {}).values():
+        total = sum(c["count"] * c["max_bytes"]
+                    for c in var["collectives"].values())
+        worst = max(worst, total)
+    return worst
+
+
+def scale_rows(names=SCALE_TARGETS) -> list[dict[str, Any]]:
+    """Predicted node-sharded scaling rows from the committed cards.
+
+    The per-round cost of every node-sharded engine is O(N) (the capped
+    raft round is O(A·N + N·L), dpos O(N + C log C) — docs/SCALE.md),
+    so bytes/round scale linearly from the card's measured-shape figure;
+    a D-device node shard divides the state traffic by D and adds the
+    per-device collective census (also O(N) by contract, scaled the
+    same way). Projections assume the config's flagship sweep count.
+    """
+    from tools.hlocheck import registry
+    rows = []
+    for name in names:
+        card = load(name)
+        if card is None:
+            raise FileNotFoundError(
+                f"no committed cost card for {name!r}; run "
+                f"`python -m tools.costmodel --update` first")
+        tgt = registry.target(name)
+        cfg = tgt.cfg
+        n0 = cfg.n_nodes
+        bytes0 = card["cost"]["bytes_per_round"]
+        flops0 = card["cost"]["flops_per_round"]
+        coll0 = _collective_bytes_per_round(card)
+        bw = card["roofline"]["hbm_peak_gbps"] * 1e9
+        for n in SCALE_NS:
+            ratio = n / n0
+            carry = _scaled_carry_bytes(cfg, n)
+            for d in SCALE_DEVICES:
+                # The collective census only exists on a mesh: the d=1
+                # row IS the committed single-device roofline (the card
+                # LEDGER's measured/predicted is computed against).
+                coll = coll0 * ratio if d > 1 else 0.0
+                bpd = bytes0 * ratio / d + coll
+                fpd = flops0 * ratio / d
+                round_s = max(bpd / bw, fpd / PEAK_FLOPS)
+                rows.append({
+                    "name": name,
+                    "engine": card["engine"],
+                    "n_nodes": n,
+                    "n_sweeps": cfg.n_sweeps,
+                    "devices": d,
+                    "carry_bytes": carry,
+                    "carry_bytes_per_device": carry // d,
+                    "fits_hbm": carry // d <= HBM_PER_DEVICE_BYTES,
+                    "bytes_per_round_per_device": bpd,
+                    "predicted_steps_per_sec": cfg.n_sweeps * n / round_s,
+                })
+    return rows
+
+
+def scale_markdown(rows: list[dict[str, Any]]) -> str:
+    """The docs/SCALE.md projection table (see __main__ --scale)."""
+    out = ["| config | N | devices | carry/device | bytes/round/device "
+           "| predicted steps/s | fits HBM |",
+           "|---|---|---|---|---|---|---|"]
+    for r in rows:
+        out.append(
+            f"| {r['name']} | {r['n_nodes'] // 1000}k | {r['devices']} "
+            f"| {r['carry_bytes_per_device'] / 1e9:.2f} GB "
+            f"| {r['bytes_per_round_per_device'] / 1e9:.2f} GB "
+            f"| {r['predicted_steps_per_sec'] / 1e6:.0f}M "
+            f"| {'yes' if r['fits_hbm'] else 'NO'} |")
+    return "\n".join(out)

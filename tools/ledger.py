@@ -1,0 +1,378 @@
+#!/usr/bin/env python3
+"""Cross-run perf ledger: one time series over every committed measurement.
+
+    python tools/ledger.py [--repo ROOT] [--out benchmarks/LEDGER.json]
+                           [--check] [--quiet]
+    make ledger                 # the same thing, with --check
+
+The repo's perf history is scattered: driver captures (``BENCH_r*.json``,
+one per growth round, stdout-scraped), multi-chip dry runs
+(``MULTICHIP_r*.json``), and the merged on-chip benchmark artifact
+(``benchmarks/RESULTS.json`` with embedded bandwidth floors + metrics
+snapshots). This tool folds them — plus the compiled cost model's
+roofline predictions (``benchmarks/parts/costcards/``) — into ONE
+``benchmarks/LEDGER.json``:
+
+  * a normalized row per measurement (``ROW_FIELDS``, exactly those
+    keys — schema-checked by ``tools/validate_trace.py --ledger`` and
+    lint-synced against its ``LEDGER_ROW_FIELDS`` registry);
+  * per-config ``measured_vs_predicted`` ratios (measured steps/s over
+    the cost card's roofline prediction — an efficiency figure, NOT
+    bounded by 1: predictions come from the CPU-backend lowering of the
+    TPU program, see tools/costmodel);
+  * ``stale_timing`` markers propagated from RESULTS rows into ledger
+    rows (``run_benchmarks.warn_stale``'s data, no longer only a
+    startup stderr line);
+  * a noise-banded regression verdict per (config, platform-class)
+    series — ``--check`` exits nonzero when any series' latest
+    measurement falls more than ``NOISE_BAND`` below its prior best.
+
+Deliberately stdlib-only and import-free of the framework, like
+``tools/validate_trace.py``: CI can run it without jax.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import pathlib
+import re
+import sys
+from typing import Any
+
+LEDGER_VERSION = 1
+
+# Relative drop below a series' prior best that counts as a regression.
+# Sized above the measured run-to-run jitter of the committed rows
+# (repeat-scan timing brought raft-5node under ±5%; the flagship rows
+# repeat within a few percent) but below any real regression worth a
+# red build (the PR 8 sort-diet classes move 2-3x).
+NOISE_BAND = 0.15
+
+# One ledger row = exactly these keys (nulls where a source has no
+# value). Mirrored import-free in tools/validate_trace.py
+# (LEDGER_ROW_FIELDS) and lint-synced both ways like the telemetry
+# counter registry.
+ROW_FIELDS = ("source", "kind", "name", "seq", "timestamp", "platform",
+              "engine", "steps_per_sec", "wall_s", "steps", "digest",
+              "stale", "predicted_steps_per_sec", "measured_vs_predicted",
+              "hbm_peak_frac_floor", "ok", "notes")
+
+# RESULTS row name -> cost-card name where they differ (the padded
+# one-program f-ladder row is costed by the fsweep card).
+CARD_FOR = {"pbft-fsweep-one-program": "pbft-100k-bcast-fsweep"}
+
+# bench.py's metric string: "raft-{N}node-{R}round[-cap{A}] ..." —
+# shapes matching a benchmark-suite config normalize onto its
+# RESULTS/cost-card name so driver captures and benchmark-suite
+# captures form ONE series (and the driver row inherits the config's
+# roofline prediction). The shapes mirror run_benchmarks.CONFIGS —
+# duplicated here because this tool stays import-free of the framework
+# (importing CONFIGS pulls jax).
+_BENCH_METRIC_RE = re.compile(
+    r"^(?P<proto>[a-z]+)-(?P<nodes>\d+)node-(?P<rounds>\d+)round"
+    r"(?:-cap(?P<cap>\d+))?.*\[(?P<plat>[^\]]+)\]")
+_BENCH_SHAPE_NAMES = {
+    ("raft", 100_000, 64, 8): "raft-100k",
+    ("raft", 1024, 1024, 0): "raft-1kx1k",
+}
+
+
+def _row(**kw: Any) -> dict[str, Any]:
+    row = {k: None for k in ROW_FIELDS}
+    row.update(kw)
+    assert set(row) == set(ROW_FIELDS), f"row keys drifted: {sorted(row)}"
+    return row
+
+
+def _load_cards(repo: pathlib.Path) -> dict[str, dict]:
+    cards = {}
+    for path in sorted((repo / "benchmarks" / "parts"
+                        / "costcards").glob("*.json")):
+        try:
+            doc = json.loads(path.read_text())
+        except ValueError:
+            continue
+        cards[doc.get("name", path.stem)] = doc
+    return cards
+
+
+def _predicted(cards: dict[str, dict], name: str) -> float | None:
+    card = cards.get(CARD_FOR.get(name, name))
+    if card is None:
+        return None
+    try:
+        return float(card["roofline"]["predicted_steps_per_sec"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _ratio(measured, predicted) -> float | None:
+    if measured and predicted:
+        return round(measured / predicted, 4)
+    return None
+
+
+def results_rows(repo: pathlib.Path, cards: dict[str, dict]) -> list[dict]:
+    """Rows from benchmarks/RESULTS.json: one per engine entry. TPU rows
+    get a roofline prediction + ratio; oracle rows are their own series
+    (a single-core C++ baseline has no device roofline). ``stale`` is
+    the row's ``stale_timing`` marker — the same datum
+    ``run_benchmarks.warn_stale`` prints at startup, now a queryable
+    column."""
+    path = repo / "benchmarks" / "RESULTS.json"
+    if not path.exists():
+        return []
+    try:
+        doc = json.loads(path.read_text())
+    except ValueError:
+        return []
+    ts = doc.get("timestamp")
+    out = []
+    for r in doc.get("rows", []):
+        name, stale = r.get("name", "?"), r.get("stale_timing")
+        for key, kind in (("tpu", "results-tpu"), ("oracle",
+                                                   "results-oracle")):
+            e = r.get(key)
+            if not isinstance(e, dict):
+                continue
+            sps = e.get("steps_per_sec")
+            pred = _predicted(cards, name) if key == "tpu" else None
+            bw = e.get("bandwidth") or {}
+            notes = []
+            if e.get("metrics"):
+                notes.append("embedded-metrics-snapshot")
+            if e.get("timing"):
+                notes.append(e["timing"])
+            out.append(_row(
+                source="benchmarks/RESULTS.json", kind=kind, name=name,
+                timestamp=ts,
+                platform=("cpu-oracle" if key == "oracle"
+                          else doc.get("platform")),
+                engine=e.get("engine"), steps_per_sec=sps,
+                wall_s=e.get("wall_s"), steps=e.get("steps"),
+                digest=e.get("digest"),
+                stale=stale if key == "tpu" else None,
+                predicted_steps_per_sec=pred,
+                measured_vs_predicted=_ratio(sps, pred),
+                hbm_peak_frac_floor=bw.get("hbm_peak_frac_floor"),
+                ok=bool(sps), notes=", ".join(notes) or None))
+    return out
+
+
+def _bench_name(metric: str) -> tuple[str, str]:
+    """(series name, platform) from a bench.py metric string; the
+    flagship shape maps onto the RESULTS/cost-card name."""
+    m = _BENCH_METRIC_RE.match(metric or "")
+    if not m:
+        return (metric or "?", "?")
+    shape = (m.group("proto"), int(m.group("nodes")), int(m.group("rounds")),
+             int(m.group("cap") or 0))
+    name = _BENCH_SHAPE_NAMES.get(shape, metric.split(" ")[0])
+    return name, m.group("plat")
+
+
+def bench_rows(repo: pathlib.Path, cards: dict[str, dict]) -> list[dict]:
+    """Rows from the driver's per-round BENCH_r*.json captures. New
+    captures carry bench.py's machine-parseable ``trajectory`` block
+    (config echo, wall, steps, timestamp); older ones only the one-line
+    metric/value pair; failed rounds (rc != 0 or an ``error`` field)
+    become ok=false rows so the history keeps its holes visible."""
+    out = []
+    for fname in sorted(glob.glob(str(repo / "BENCH_r*.json"))):
+        path = pathlib.Path(fname)
+        try:
+            doc = json.loads(path.read_text())
+        except ValueError:
+            continue
+        parsed = doc.get("parsed") or {}
+        traj = parsed.get("trajectory") or {}
+        if traj:
+            # Structured rows carry the shape directly — no scraping.
+            shape = (traj.get("protocol"), traj.get("nodes"),
+                     traj.get("rounds"), traj.get("max_active"))
+            name = _BENCH_SHAPE_NAMES.get(shape, (
+                f"{shape[0]}-{shape[1]}node-{shape[2]}round"
+                + (f"-cap{shape[3]}" if shape[3] else "")))
+            plat = _bench_name(parsed.get("metric", ""))[1]
+            if plat == "?":
+                plat = traj.get("platform", "?")
+        else:
+            name, plat = _bench_name(parsed.get("metric", ""))
+        sps = parsed.get("value") or None
+        ok = doc.get("rc") == 0 and bool(sps) and "error" not in parsed
+        pred = _predicted(cards, name) if _plat_class(plat) == "tpu" \
+            else None
+        notes = []
+        if parsed.get("error"):
+            notes.append(str(parsed["error"])[:120])
+        elif not parsed:
+            notes.append("no parseable benchmark line (rc="
+                         f"{doc.get('rc')})")
+        out.append(_row(
+            source=path.name, kind="driver-bench", name=name,
+            seq=doc.get("n"), timestamp=traj.get("timestamp"),
+            platform=plat if plat != "?" else None,
+            engine="tpu", steps_per_sec=sps, wall_s=traj.get("wall_s"),
+            steps=traj.get("steps"), digest=None, stale=None,
+            predicted_steps_per_sec=pred,
+            measured_vs_predicted=_ratio(sps, pred),
+            hbm_peak_frac_floor=None, ok=ok,
+            notes=", ".join(notes) or None))
+    return out
+
+
+def multichip_rows(repo: pathlib.Path) -> list[dict]:
+    out = []
+    for fname in sorted(glob.glob(str(repo / "MULTICHIP_r*.json"))):
+        path = pathlib.Path(fname)
+        try:
+            doc = json.loads(path.read_text())
+        except ValueError:
+            continue
+        m = re.search(r"r(\d+)", path.stem)
+        seq = int(m.group(1)) if m else None
+        out.append(_row(
+            source=path.name, kind="multichip-dryrun",
+            name=f"dryrun-multichip-{doc.get('n_devices', '?')}dev",
+            seq=seq, engine="tpu",
+            ok=bool(doc.get("ok")) and not doc.get("skipped"),
+            notes="skipped" if doc.get("skipped") else None))
+    return out
+
+
+def _plat_class(platform: str | None) -> str:
+    """Series bucket: a single-core oracle baseline, a real-accelerator
+    capture, and a CPU-backend fallback are three different instruments
+    — comparing across them manufactures fake regressions."""
+    p = platform or ""
+    if p == "cpu-oracle":
+        return "oracle"
+    return "tpu" if p.startswith(("tpu", "axon")) else "cpu"
+
+
+def _point_order(row: dict) -> tuple:
+    """Chronological sort key for one series' points: timestamp when a
+    row carries one (RESULTS, trajectory-era BENCH rows), else the
+    driver round number. Rows without either sort first — concatenation
+    order is NOT chronology (bench_rows precede the RESULTS artifact in
+    the row list, so a fresh driver capture would otherwise never be
+    the 'latest' point and a regression in it could never fire)."""
+    return (row["timestamp"] or 0.0, row["seq"] or 0)
+
+
+def build_series(rows: list[dict]) -> dict[str, dict]:
+    """Per-(name, platform-class) measurement series + noise-banded
+    verdict: points ordered chronologically (:func:`_point_order`), the
+    LATEST compared against the best EARLIER one."""
+    groups: dict[str, list[dict]] = {}
+    for row in rows:
+        # ok=false rows (failed rounds, degenerate nothing-committed
+        # runs) stay visible in the row list but must not drive a
+        # verdict: a meaningless value as 'latest' reds a healthy tree,
+        # as 'best prior' flags every later healthy run.
+        if row["kind"] == "multichip-dryrun" or not row["steps_per_sec"] \
+                or not row["ok"]:
+            continue
+        key = f"{row['name']}@{_plat_class(row['platform'])}"
+        groups.setdefault(key, []).append(row)
+    out = {}
+    for key, grp in sorted(groups.items()):
+        grp = sorted(grp, key=_point_order)
+        pts = [{"source": r["source"], "seq": r["seq"],
+                "steps_per_sec": r["steps_per_sec"],
+                "stale": r["stale"]} for r in grp]
+        latest = grp[-1]
+        # Stale-marked points are known-bad timings in BOTH directions:
+        # not a red 'latest' (below) and not the baseline either — a
+        # pre-fix measurement that overstated steps/s must not verdict
+        # the first fresh correct measurement a regression.
+        prior = [r for r in grp[:-1] if not r["stale"]]
+        entry: dict[str, Any] = {"n_points": len(grp), "points": pts,
+                                 "latest": latest["steps_per_sec"]}
+        if not prior:
+            entry.update(verdict="single-point", best_prior=None,
+                         ratio=None)
+        else:
+            best = max(r["steps_per_sec"] for r in prior)
+            ratio = latest["steps_per_sec"] / best
+            entry.update(
+                best_prior=best, ratio=round(ratio, 4),
+                verdict=("regression" if ratio < 1.0 - NOISE_BAND
+                         else "ok"))
+            if entry["verdict"] == "regression" and latest["stale"]:
+                # A stale-marked latest point is a known-bad timing, not
+                # fresh evidence — surfaced, never a red build.
+                entry["verdict"] = "stale-latest"
+        out[key] = entry
+    return out
+
+
+def build(repo: pathlib.Path) -> dict[str, Any]:
+    cards = _load_cards(repo)
+    rows = (bench_rows(repo, cards) + multichip_rows(repo)
+            + results_rows(repo, cards))
+    series = build_series(rows)
+    regressions = sorted(k for k, s in series.items()
+                         if s["verdict"] == "regression")
+    stale = [{"name": r["name"], "source": r["source"], "note": r["stale"]}
+             for r in rows if r["stale"]]
+    return {
+        "version": LEDGER_VERSION,
+        # Deterministic provenance (NOT a wall clock: the ledger is a
+        # committed artifact and identical inputs must regenerate the
+        # identical bytes, like the fingerprints and cost cards).
+        "newest_input_unix": max((r["timestamp"] for r in rows
+                                  if r["timestamp"]), default=None),
+        "noise_band": NOISE_BAND,
+        "n_cost_cards": len(cards),
+        "rows": rows,
+        "series": series,
+        "regressions": regressions,
+        "stale_rows": stale,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fold BENCH/MULTICHIP/RESULTS captures + cost-card "
+                    "predictions into benchmarks/LEDGER.json.")
+    ap.add_argument("--repo", default=str(pathlib.Path(__file__).
+                                          resolve().parents[1]))
+    ap.add_argument("--out", default="",
+                    help="output path (default <repo>/benchmarks/"
+                         "LEDGER.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero when any series regressed past "
+                         "the noise band")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    repo = pathlib.Path(args.repo)
+    doc = build(repo)
+    out = pathlib.Path(args.out) if args.out else \
+        repo / "benchmarks" / "LEDGER.json"
+    out.write_text(json.dumps(doc, indent=2) + "\n")
+
+    def log(msg: str) -> None:
+        if not args.quiet:
+            print(f"ledger: {msg}", file=sys.stderr, flush=True)
+
+    log(f"{len(doc['rows'])} rows, {len(doc['series'])} series, "
+        f"{doc['n_cost_cards']} cost cards -> {out}")
+    for s in doc["stale_rows"]:
+        log(f"STALE {s['name']} ({s['source']}): {s['note']}")
+    for key, s in doc["series"].items():
+        if s["verdict"] != "single-point":
+            log(f"{key}: latest {s['latest'] / 1e6:.2f}M vs best prior "
+                f"{s['best_prior'] / 1e6:.2f}M ({s['ratio']:.2f}x) "
+                f"-> {s['verdict']}")
+    if doc["regressions"]:
+        log(f"REGRESSIONS: {', '.join(doc['regressions'])}")
+        return 1 if args.check else 0
+    log("no regressions past the noise band")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

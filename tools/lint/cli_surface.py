@@ -35,10 +35,12 @@ NATIVE = "cpp/consensus_sim.cpp"
 
 # Python-CLI flags handled outside _FLAG_FIELDS (the --mesh spelling of
 # mesh_shape), and native flags that are not Config fields (--scenario
-# names a scripted attack from consensus_tpu/scenarios — both front
-# doors parse it, the Python side as a dedicated argparse flag).
+# names a scripted attack from consensus_tpu/scenarios, --serve-port
+# the live-introspection endpoint from obs/serve.py — both front doors
+# parse them, the Python side as dedicated argparse flags).
 PY_SPECIAL = {"mesh_shape": "--mesh"}
-NATIVE_NON_CONFIG = {"oracle-delivery", "out", "help", "scenario"}
+NATIVE_NON_CONFIG = {"oracle-delivery", "out", "help", "scenario",
+                     "serve-port"}
 
 _NATIVE_FLAG_RE = re.compile(r'k == "--([a-z0-9-]+)"')
 

@@ -10,6 +10,14 @@ not import it:
     schema tripwire and the engines have drifted: the validator would
     reject fresh CLI reports (or silently accept unknown ones).
 
+  * FIELD REGISTRIES — the Observatory's producer-side exactly-these-
+    keys declarations versus the validator's import-free mirrors:
+    tools/costmodel/model.py CARD_FIELDS ↔ validate_trace
+    COST_CARD_FIELDS, and tools/ledger.py ROW_FIELDS ↔ validate_trace
+    LEDGER_ROW_FIELDS. Drift in either direction means the schema
+    tripwire rejects fresh artifacts or silently accepts stale ones —
+    the same failure mode as the telemetry counters.
+
   * CRASH_SPLIT — SPEC §6c requires every engine to partition its carry
     into persistent state (survives a crash; what the protocol's safety
     argument rests on) and volatile state (reset on recovery). The
@@ -123,6 +131,50 @@ def _latency_violations(repo: Repo) -> list[Violation]:
     return _names_violations(repo, suffix="LATENCY",
                              var="LATENCY_HISTOGRAMS",
                              kind="latency histogram")
+
+
+# --- Observatory field registries ------------------------------------------
+
+# (producer file, producer tuple name, validator frozenset name)
+FIELD_REGISTRIES = (
+    ("tools/costmodel/model.py", "CARD_FIELDS", "COST_CARD_FIELDS"),
+    ("tools/ledger.py", "ROW_FIELDS", "LEDGER_ROW_FIELDS"),
+)
+
+
+def _fields_violations(repo: Repo) -> list[Violation]:
+    """Two-way sync of the producers' exactly-these-keys tuples against
+    the import-free mirrors in tools/validate_trace.py."""
+    errs: list[Violation] = []
+    for producer, tup_name, var in FIELD_REGISTRIES:
+        if not repo.exists(producer):
+            errs.append(repo.missing(CHECK, producer))
+            continue
+        declared = _module_str_tuples(repo.tree(producer), {}).get(tup_name)
+        if declared is None:
+            errs.append(Violation(
+                CHECK, producer, 0,
+                f"no {tup_name} literal tuple found — the validator sync "
+                "has nothing to check against"))
+            continue
+        got = _validator_registry(repo, var)
+        if got is None:
+            errs.append(Violation(CHECK, VALIDATOR, 0,
+                                  f"no {var} registry found"))
+            continue
+        registry, reg_line = got
+        for field in sorted(set(declared) - registry):
+            errs.append(Violation(
+                CHECK, producer, 0,
+                f"field {field!r} ({tup_name}) is missing from "
+                f"{VALIDATOR} {var} — the schema tripwire would reject "
+                "fresh artifacts"))
+        for field in sorted(registry - set(declared)):
+            errs.append(Violation(
+                CHECK, VALIDATOR, reg_line,
+                f"{var} entry {field!r} is emitted by no producer "
+                f"({producer} {tup_name}) — stale registry entry"))
+    return errs
 
 
 # --- CRASH_SPLIT -----------------------------------------------------------
@@ -288,4 +340,4 @@ def _crash_split_violations(repo: Repo) -> list[Violation]:
 
 def check(repo: Repo) -> list[Violation]:
     return (_telemetry_violations(repo) + _latency_violations(repo)
-            + _crash_split_violations(repo))
+            + _fields_violations(repo) + _crash_split_violations(repo))

@@ -110,6 +110,38 @@ CHECKPOINT_IO_FIELDS = frozenset({
 _CHECKPOINT_IO_INTS = frozenset({"saves", "loads", "bytes_written",
                                  "bytes_read"})
 
+# Cost-card top-level keys (tools/costmodel/model.py CARD_FIELDS —
+# lint-synced both ways like the telemetry counters): the Observatory's
+# per-config compiled cost summary, committed under
+# benchmarks/parts/costcards/ and drift-gated by `make check`'s
+# costcheck layer (docs/OBSERVABILITY.md §"Observatory").
+COST_CARD_FIELDS = frozenset({
+    "schema", "name", "engine", "chunk_rounds", "toolchain", "config",
+    "cost", "roofline", "collectives",
+})
+_COST_SUBFIELDS = frozenset({
+    "flops_per_round", "bytes_per_round", "arithmetic_intensity",
+    "steps_per_round", "bytes_per_step", "transcendentals_per_round",
+})
+_ROOFLINE_SUBFIELDS = frozenset({
+    "hbm_peak_gbps", "peak_flops", "bound", "predicted_round_s",
+    "predicted_steps_per_sec",
+})
+
+# One benchmarks/LEDGER.json row = exactly these keys (tools/ledger.py
+# ROW_FIELDS — lint-synced both ways). Nulls are legal where a source
+# has no value; the KEYS may not drift.
+LEDGER_ROW_FIELDS = frozenset({
+    "source", "kind", "name", "seq", "timestamp", "platform", "engine",
+    "steps_per_sec", "wall_s", "steps", "digest", "stale",
+    "predicted_steps_per_sec", "measured_vs_predicted",
+    "hbm_peak_frac_floor", "ok", "notes",
+})
+_LEDGER_KINDS = frozenset({"results-tpu", "results-oracle", "driver-bench",
+                           "multichip-dryrun"})
+_LEDGER_VERDICTS = frozenset({"ok", "regression", "single-point",
+                              "stale-latest"})
+
 _SCALAR = (bool, int, float, str, type(None))
 
 
@@ -353,6 +385,13 @@ def validate_metrics(path) -> list:
                 errs.append(f"{path}: gauge {name} value must be a number")
         elif typ == "histogram":
             errs += [f"{path}: {e}" for e in _validate_histogram(name, d)]
+        elif typ == "info":
+            labels = d.get("labels")
+            if not isinstance(labels, dict) or not all(
+                    isinstance(k, str) and isinstance(v, str)
+                    for k, v in labels.items()):
+                errs.append(f"{path}: info {name} labels must be a "
+                            "str->str object")
         else:
             errs.append(f"{path}: metric {name!r} has unknown type {typ!r}")
     if "flight" in doc:
@@ -498,6 +537,122 @@ def validate_cli_report(path) -> list:
     return errs
 
 
+def validate_costcard(path) -> list:
+    """Schema checks for one committed cost card
+    (docs/OBSERVABILITY.md §"Observatory"): exactly the registered
+    top-level keys, internally consistent cost/roofline blocks."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable/not JSON: {exc}"]
+    if not isinstance(doc, dict):
+        return [f"{path}: top level must be an object"]
+    errs = []
+    for key in sorted(COST_CARD_FIELDS - set(doc)):
+        errs.append(f"{path}: cost card missing key {key!r}")
+    for key in sorted(set(doc) - COST_CARD_FIELDS):
+        errs.append(f"{path}: cost card key {key!r} is not in the "
+                    "known-field registry (costmodel and validator "
+                    "drifted?)")
+    cost = doc.get("cost")
+    if isinstance(cost, dict):
+        for key in sorted(_COST_SUBFIELDS - set(cost)):
+            errs.append(f"{path}: cost missing key {key!r}")
+        for key in ("flops_per_round", "bytes_per_round",
+                    "steps_per_round"):
+            v = cost.get(key)
+            if key in cost and (not _num(v) or v <= 0):
+                errs.append(f"{path}: cost.{key} must be a number > 0")
+        ai, fl, by = (cost.get(k) for k in ("arithmetic_intensity",
+                                            "flops_per_round",
+                                            "bytes_per_round"))
+        if all(_num(x) for x in (ai, fl, by)) and by > 0 \
+                and abs(ai - fl / by) > 1e-6 * max(1.0, abs(ai)):
+            errs.append(f"{path}: cost.arithmetic_intensity {ai} != "
+                        f"flops/bytes {fl / by}")
+    elif "cost" in doc:
+        errs.append(f"{path}: 'cost' must be an object")
+    roof = doc.get("roofline")
+    if isinstance(roof, dict):
+        for key in sorted(_ROOFLINE_SUBFIELDS - set(roof)):
+            errs.append(f"{path}: roofline missing key {key!r}")
+        if "bound" in roof and roof["bound"] not in ("bandwidth",
+                                                     "compute"):
+            errs.append(f"{path}: roofline.bound must be 'bandwidth' or "
+                        f"'compute', got {roof.get('bound')!r}")
+        v = roof.get("predicted_steps_per_sec")
+        if "predicted_steps_per_sec" in roof and (not _num(v) or v <= 0):
+            errs.append(f"{path}: roofline.predicted_steps_per_sec must "
+                        "be a number > 0")
+    elif "roofline" in doc:
+        errs.append(f"{path}: 'roofline' must be an object")
+    if doc.get("schema") != 1:
+        errs.append(f"{path}: schema {doc.get('schema')!r} != 1")
+    return errs
+
+
+def validate_ledger(path) -> list:
+    """Schema checks for benchmarks/LEDGER.json (tools/ledger.py): row
+    keys against the registry, series verdicts from the known set, and
+    the measured-vs-predicted contract (every results-tpu row carries a
+    prediction + ratio)."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable/not JSON: {exc}"]
+    if not isinstance(doc, dict):
+        return [f"{path}: top level must be an object"]
+    errs = []
+    if doc.get("version") != 1:
+        errs.append(f"{path}: version {doc.get('version')!r} != 1")
+    rows = doc.get("rows")
+    if not isinstance(rows, list):
+        return errs + [f"{path}: 'rows' must be a list"]
+    for i, r in enumerate(rows):
+        if not isinstance(r, dict):
+            errs.append(f"{path}: rows[{i}] must be an object")
+            continue
+        for key in sorted(LEDGER_ROW_FIELDS - set(r)):
+            errs.append(f"{path}: rows[{i}] missing key {key!r}")
+        for key in sorted(set(r) - LEDGER_ROW_FIELDS):
+            errs.append(f"{path}: rows[{i}] key {key!r} is not in the "
+                        "known-field registry (ledger and validator "
+                        "drifted?)")
+        if r.get("kind") not in _LEDGER_KINDS:
+            errs.append(f"{path}: rows[{i}].kind {r.get('kind')!r} not in "
+                        f"{sorted(_LEDGER_KINDS)}")
+        for key in ("steps_per_sec", "wall_s", "predicted_steps_per_sec",
+                    "measured_vs_predicted"):
+            v = r.get(key)
+            if v is not None and key in r and (not _num(v) or v < 0):
+                errs.append(f"{path}: rows[{i}].{key} must be null or a "
+                            "number >= 0")
+        if r.get("kind") == "results-tpu" and r.get("steps_per_sec"):
+            # The Observatory acceptance contract: every measured
+            # RESULTS row is judged against the cost model.
+            for key in ("predicted_steps_per_sec",
+                        "measured_vs_predicted"):
+                if not _num(r.get(key)) or r[key] <= 0:
+                    errs.append(f"{path}: rows[{i}] ({r.get('name')}): "
+                                f"results-tpu row has no {key} — cost "
+                                "card missing or unmatched")
+    series = doc.get("series")
+    if series is not None and not isinstance(series, dict):
+        errs.append(f"{path}: 'series' must be an object")
+    elif isinstance(series, dict):
+        for key, s in sorted(series.items()):
+            if not isinstance(s, dict) \
+                    or s.get("verdict") not in _LEDGER_VERDICTS:
+                errs.append(f"{path}: series {key!r} verdict "
+                            f"{s.get('verdict') if isinstance(s, dict) else s!r} "
+                            f"not in {sorted(_LEDGER_VERDICTS)}")
+    if not isinstance(doc.get("regressions"), list):
+        errs.append(f"{path}: 'regressions' must be a list")
+    if not isinstance(doc.get("stale_rows"), list):
+        errs.append(f"{path}: 'stale_rows' must be a list")
+    return errs
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Validate trace JSONL / metrics JSON / RunReport "
@@ -510,6 +665,13 @@ def main(argv=None) -> int:
                          "stdout); telemetry counter names and "
                          "checkpoint_io fields are checked against the "
                          "known-name registries")
+    ap.add_argument("--costcard", action="append", default=[],
+                    help="a committed cost card "
+                         "(benchmarks/parts/costcards/*.json; "
+                         "repeatable)")
+    ap.add_argument("--ledger", default="",
+                    help="the cross-run perf ledger "
+                         "(benchmarks/LEDGER.json)")
     ap.add_argument("--expect-spans", default="",
                     help="comma-separated registered span names that MUST "
                          "appear in --trace (e.g. 'ckpt_snapshot,"
@@ -520,9 +682,10 @@ def main(argv=None) -> int:
                          "appear in --trace (e.g. 'attempt_failed' for a "
                          "supervised-retry trace)")
     args = ap.parse_args(argv)
-    if not (args.trace or args.metrics or args.report or args.cli_report):
+    if not (args.trace or args.metrics or args.report or args.cli_report
+            or args.costcard or args.ledger):
         ap.error("nothing to validate: pass --trace/--metrics/--report/"
-                 "--cli-report")
+                 "--cli-report/--costcard/--ledger")
     if (args.expect_spans or args.expect_events) and not args.trace:
         ap.error("--expect-spans/--expect-events need --trace (they assert "
                  "presence in that file)")
@@ -545,6 +708,10 @@ def main(argv=None) -> int:
         errs += validate_report(args.report)
     if args.cli_report:
         errs += validate_cli_report(args.cli_report)
+    for card in args.costcard:
+        errs += validate_costcard(card)
+    if args.ledger:
+        errs += validate_ledger(args.ledger)
     for e in errs:
         print(f"validate_trace: {e}", file=sys.stderr)
     if errs:
