@@ -1,7 +1,8 @@
 # Repo-level entry points. The whole gate is ONE command:
 #
 #   make check     # consensus-lint + hlocheck + costcheck + ruff + mypy
-#                  # + clang-tidy + scenario smoke + tier-1
+#                  # + clang-tidy + scenario smoke + advsearch smoke
+#                  # + tier-1
 #   make ledger    # cross-run perf ledger + regression verdict
 #
 # (tools/check.py gates hlocheck on jax and ruff/mypy/clang-tidy on
@@ -31,6 +32,9 @@ tidy:
 scenario-smoke:
 	$(PY) tools/check.py --only scenarios
 
+advsearch-smoke:
+	$(PY) tools/check.py --only advsearch
+
 san-test:
 	$(MAKE) -C cpp san-test
 
@@ -40,4 +44,4 @@ test:
 	  -p no:xdist -p no:randomly
 
 .PHONY: check lint hlocheck costcheck ledger tidy san-test scenario-smoke \
-	test
+	advsearch-smoke test

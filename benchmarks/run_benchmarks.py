@@ -10,13 +10,15 @@ Writes benchmarks/RESULTS.json and prints a table. Run on the TPU chip:
 
 Oracle tractability: since the edge-wise delivery layer (cpp/oracle.cpp
 Net EDGE mode + the O(A·N) capped iteration, docs/PERF.md "oracle
-asymptotics"), the oracle runs every BASELINE config at its TRUE shape
-except raft-1kx1k — so each flagship row pairs the TPU digest with an
-oracle digest of the same config (benchmarks/parts/oracle-100k.json).
-raft-1kx1k is the one dense-semantics holdout (every pair queried ~7
-times over 1024 rounds ≈ 10^13 mixer evals single-core ≈ a day); it
-keeps a scaled-down oracle stand-in, recorded verbatim in the JSON — no
-extrapolated numbers are reported as measurements.
+asymptotics"), the oracle runs EVERY BASELINE config at its TRUE shape
+— so each flagship row pairs the TPU digest with an oracle digest of
+the same config (benchmarks/parts/oracle-100k.json). The last holdout,
+dense raft-1kx1k, fell to arithmetic: the old "~10^13 mixer evals ≈ a
+day single-core" estimate was ~100x off (the dense Net materializes the
+[N, N] matrix ONCE per round — one mixer chain per pair per round,
+8 x 1024 x 1024^2 ≈ 8.6e9 total), and the measured full-shape run is
+~42 s with a digest byte-equal to the committed on-chip TPU row
+(pinned by tests/test_oracle_benchscale.py).
 """
 from __future__ import annotations
 
@@ -75,24 +77,22 @@ CONFIGS = {
 
 PBFT_FS = [1, 2, 4, 8, 16, 32, 64, 128]
 
-# Oracle-sized stand-ins — RETIRED for every capped/aggregate config now
-# that delivery is edge-wise (the raft-100k / pbft-100k-bcast /
-# paxos-10kx10k / dpos-100k rows run the oracle at their true flagship
-# shape; measured wall times in benchmarks/parts/oracle-100k.json and
-# docs/PERF.md). The one survivor is raft-1kx1k: dense SPEC §3 semantics
-# query ~every pair ~7x per round, so edge-wise buys nothing and the full
-# 8x1024x1024² run is ~a day single-core — it keeps a scaled-down config,
-# recorded verbatim (never extrapolated).
-ORACLE_SIZED = {
-    "raft-1kx1k": dataclasses.replace(CONFIGS["raft-1kx1k"], n_sweeps=1,
-                                      n_rounds=32),
-}
+# Oracle-sized stand-ins — fully RETIRED: every BASELINE config runs
+# the oracle at its true flagship shape (measured wall times in
+# benchmarks/parts/oracle-100k.json and docs/PERF.md). The capped/
+# aggregate configs fell to the edge-wise delivery layer; the last
+# holdout, dense raft-1kx1k, fell to arithmetic — the dense Net
+# materializes one mixer chain per pair per round (~8.6e9 for the full
+# 8x1024x1024-round shape ≈ 42 s single-core), not the ~10^13 the old
+# stand-in comment estimated. Kept (empty) so older drivers' .get()
+# lookups stay valid.
+ORACLE_SIZED: dict[str, Config] = {}
 
 # Flagship-shape oracle rows are minutes-class, not seconds-class —
 # measure once instead of best-of-2 (single-core C++ has no warmup
 # effect worth a second multi-minute run).
 ORACLE_ONE_REPEAT = {"raft-100k", "pbft-100k-bcast", "paxos-10kx10k",
-                     "dpos-100k"}
+                     "dpos-100k", "raft-1kx1k"}
 
 # Dispatch-bound configs: the whole 5-node run is sub-millisecond of
 # device time, so back-to-back separate dispatches time the tunnel's

@@ -105,6 +105,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "Snapshots are written by a double-buffered "
                          "background writer so the chunk loop never waits "
                          "on IO (docs/PERF.md; --sync-checkpoints opts out)")
+    ap.add_argument("--group-dir", default="",
+                    help="grouped-sweep resumable layout (requires "
+                         "--sweep-chunk grouping; exclusive with "
+                         "--checkpoint): each sweep group snapshots into "
+                         "its own subdirectory plus a completed-group "
+                         "manifest, and an interrupted run resumes by "
+                         "skipping completed groups and continuing the "
+                         "first incomplete one mid-scan "
+                         "(docs/RESILIENCE.md)")
     ap.add_argument("--sync-checkpoints", action="store_true",
                     help="write each snapshot synchronously on the chunk "
                          "loop (the pre-async behavior) instead of the "
@@ -343,6 +352,7 @@ def main(argv=None) -> int:
             ("--mesh" if "mesh" in typed else "config field mesh_shape",
              "mesh" in typed or cfg.mesh_shape),
             ("--checkpoint", args.checkpoint),
+            ("--group-dir", args.group_dir),
             ("--sync-checkpoints", args.sync_checkpoints),
             ("--fsync-checkpoints", args.fsync_checkpoints),
             ("--keep-checkpoints", "keep_checkpoints" in typed),
@@ -376,25 +386,33 @@ def main(argv=None) -> int:
     if args.checkpoint and cfg.sweep_chunk and cfg.sweep_chunk < cfg.n_sweeps:
         parser.error("--checkpoint is not supported with sweep_chunk "
                      "grouping (one rotation set cannot hold N groups' "
-                     "snapshots); use --scan-chunk for mid-run snapshots "
-                     "or drop --sweep-chunk. The per-group layout exists "
-                     "as groundwork — runner.run(group_dir=...) writes "
-                     "group subdirectories + a completed-group manifest; "
-                     "supervisor-driven grouped resume is a future PR")
+                     "snapshots); use --group-dir for the per-group "
+                     "resumable layout, or --scan-chunk for mid-run "
+                     "snapshots of an ungrouped run")
+    if args.group_dir:
+        if args.checkpoint:
+            parser.error("--group-dir and --checkpoint are exclusive "
+                         "(the grouped layout snapshots per group)")
+        if not cfg.sweep_chunk or cfg.sweep_chunk >= cfg.n_sweeps:
+            parser.error("--group-dir needs --sweep-chunk grouping "
+                         "(sweep_chunk in (0, n_sweeps)); use "
+                         "--checkpoint for an ungrouped run")
     if args.serve_port is not None and not 0 <= args.serve_port <= 65535:
         parser.error(f"--serve-port must be in [0, 65535] (0 = ephemeral), "
                      f"got {args.serve_port}")
     keep = getattr(args, "keep_checkpoints", 2)
-    if "keep_checkpoints" in vars(args) and not args.checkpoint:
-        parser.error("--keep-checkpoints requires --checkpoint (it is the "
-                     "snapshot rotation depth)")
-    if args.fsync_checkpoints and not args.checkpoint:
-        parser.error("--fsync-checkpoints requires --checkpoint (there is "
-                     "nothing to make durable without snapshots)")
-    if args.sync_checkpoints and not args.checkpoint:
-        parser.error("--sync-checkpoints requires --checkpoint (it selects "
-                     "HOW snapshots are written; nothing is saved without "
-                     "one)")
+    snapshots_on = args.checkpoint or args.group_dir
+    if "keep_checkpoints" in vars(args) and not snapshots_on:
+        parser.error("--keep-checkpoints requires --checkpoint or "
+                     "--group-dir (it is the snapshot rotation depth)")
+    if args.fsync_checkpoints and not snapshots_on:
+        parser.error("--fsync-checkpoints requires --checkpoint or "
+                     "--group-dir (there is nothing to make durable "
+                     "without snapshots)")
+    if args.sync_checkpoints and not snapshots_on:
+        parser.error("--sync-checkpoints requires --checkpoint or "
+                     "--group-dir (it selects HOW snapshots are written; "
+                     "nothing is saved without one)")
     if keep < 1:
         parser.error(f"--keep-checkpoints must be >= 1, got {keep}")
     if args.retries < 0:
@@ -411,6 +429,7 @@ def main(argv=None) -> int:
             parser.error("--f-sweep requires --protocol pbft --engine tpu")
         unsupported = [name for name, on in [
             ("--checkpoint", args.checkpoint),
+            ("--group-dir", args.group_dir),
             ("--profile", args.profile),
             ("--retries/--deadline/--fallback-cpu", supervise),
             ("--crash-prob", cfg.crash_prob > 0),
@@ -610,6 +629,11 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
                       keep_checkpoints=keep,
                       fsync_checkpoints=args.fsync_checkpoints,
                       sync_checkpoints=args.sync_checkpoints)
+    elif args.group_dir:
+        run_kw = dict(group_dir=args.group_dir, resume=True,
+                      keep_checkpoints=keep,
+                      fsync_checkpoints=args.fsync_checkpoints,
+                      sync_checkpoints=args.sync_checkpoints)
     if args.telemetry:
         run_kw["telemetry"] = True
     if args.oracle_delivery != "auto":
@@ -627,6 +651,7 @@ def _execute(cfg, args, platform_tag: str, keep: int, supervise: bool,
                 deadline_s=args.deadline or None,
                 fallback_cpu=args.fallback_cpu,
                 checkpoint_path=args.checkpoint or None,
+                group_dir=args.group_dir or None,
                 keep_checkpoints=keep,
                 fsync_checkpoints=args.fsync_checkpoints,
                 sync_checkpoints=args.sync_checkpoints,

@@ -251,6 +251,25 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     def attack_cutoff(self) -> int:
         return prob_threshold_u32(self.attack_rate)
 
+    # Static adversary GATES — the Python-level on/off facts the engines
+    # branch on while tracing (the cutoff VALUES only ever feed jnp
+    # compares). Engines must read these instead of comparing cutoffs
+    # directly so that a knob-batched search program
+    # (core/knobs.KnobView) can trace per-candidate cutoff values under
+    # a statically-gated base config: the gate stays a Python bool, the
+    # value becomes an operand.
+    @property
+    def crash_on(self) -> bool:
+        return self.crash_cutoff > 0
+
+    @property
+    def miss_on(self) -> bool:
+        return self.miss_cutoff > 0
+
+    @property
+    def no_partition(self) -> bool:
+        return self.partition_cutoff == 0
+
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
         d["mesh_shape"] = list(self.mesh_shape)

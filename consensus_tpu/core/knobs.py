@@ -1,0 +1,69 @@
+"""Traced-knob Config view — the device side of the adversary search.
+
+The engines read two different kinds of information off a
+:class:`~consensus_tpu.core.config.Config` while tracing:
+
+  * **static structure** — shapes, protocol/engine dispatch, the
+    adversary GATES (``crash_on``/``miss_on``/``no_partition``, the
+    ``attack`` kind string, the ``max_delay_rounds`` loop depth, the
+    ``max_crashed`` cap shape). These decide WHAT gets traced and must
+    be Python values.
+  * **knob VALUES** — the u32 probability cutoffs (``drop_cutoff``,
+    ``crash_cutoff``, ...) and ``attack_target``. These only ever feed
+    ``jnp`` compares/indexing (``ops/adversary.cutoff`` is a
+    ``jnp.uint32`` cast), so they can just as well be *operands* of the
+    compiled program as constants baked into it.
+
+:class:`KnobView` exploits that split: it duck-types a Config whose
+knob values are JAX tracers while everything else delegates to a static
+base Config. ``runner.run_knob_batch`` vmaps engine rounds over
+per-lane knob vectors through this view, which is what lets a whole
+*generation* of adversary-search candidates (tools/advsearch) share ONE
+compiled XLA program per (protocol, static shape) — no per-candidate
+recompile.
+
+Soundness: a lane whose traced knob values equal a real Config's
+cutoffs computes the identical trajectory (same draws, same u32
+compares — tests/test_advsearch.py pins lane-vs-production bit-identity
+per engine). A gated-on feature with a zero traced cutoff never fires,
+so its lane is value-identical to the feature-off program.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from .config import Config
+
+# The traced knob slots, in column order — the one declaration shared
+# by KnobView, runner.run_knob_batch's kmat layout, and
+# tools/advsearch's candidate encoding. All are u32 cutoffs except
+# attack_target (a node id, also u32 on device).
+KNOB_COLUMNS = ("drop_cutoff", "partition_cutoff", "churn_cutoff",
+                "crash_cutoff", "recover_cutoff", "miss_cutoff",
+                "attack_cutoff", "attack_target")
+
+
+class KnobView:
+    """A Config stand-in with traced knob values over a static base.
+
+    ``base`` supplies every static fact — including the gates, so the
+    base must be *gate-representative* for the knobs a lane may vary
+    (e.g. ``crash_prob > 0`` on the base whenever any lane traces a
+    nonzero ``crash_cutoff``; tools/advsearch's spaces construct such a
+    base). ``traced`` maps :data:`KNOB_COLUMNS` names to scalars
+    (tracers inside the program); unnamed knobs fall through to the
+    base's static values.
+    """
+
+    def __init__(self, base: Config, **traced: Any):
+        unknown = set(traced) - set(KNOB_COLUMNS)
+        if unknown:
+            raise ValueError(f"unknown traced knobs {sorted(unknown)} "
+                             f"(tracable: {list(KNOB_COLUMNS)})")
+        self._base = base
+        for name in KNOB_COLUMNS:
+            setattr(self, name, traced.get(name, getattr(base, name)))
+
+    def __getattr__(self, name: str) -> Any:
+        # Only reached for names not set in __init__ — the static side.
+        return getattr(self._base, name)

@@ -60,6 +60,11 @@ STREAM_CRASH = np.uint32(0x68E31DA5)    # per (round, node) crash/recover draw
 STREAM_SLOTMISS = np.uint32(0x7F4A7C15)  # per (round, producer) DPoS slot miss
 STREAM_DELAY = np.uint32(0x2545F491)     # per (origin round, d, edge) retransmit
 STREAM_ATTACK = np.uint32(0xBB67AE85)    # per round targeted-attack activation
+# Host-side adversary-search orchestration (tools/advsearch): candidate
+# sampling, mutation and eval-seed draws. Never drawn on device or in
+# the oracle — registered so search runs replay exactly from one seed
+# without colliding with any simulation stream.
+STREAM_SEARCH = np.uint32(0x3C6EF372)   # per (generation, subdraw, index)
 
 # --- machine-checked stream registry (tools/lint, check `streams`) ---------
 #
@@ -86,14 +91,17 @@ STREAM_KEYS = {
     "STREAM_SLOTMISS": ("round", "subdraw", "producer"),  # c0: 0 (reserved)
     "STREAM_DELAY": ("origin_round", "delay", "edge"),  # via the §A.2 mixer
     "STREAM_ATTACK": ("round", None, None),
+    "STREAM_SEARCH": ("generation", "subdraw", "index"),
 }
 
 # Streams the C++ oracle deliberately does NOT mirror (cpp/threefry.h):
 # the SPEC §A.3 targeted Raft attacks are TPU-engine-only — Config
 # rejects attack != "none" on the cpu engine rather than silently
 # simulating different trajectories. (§6c STREAM_CRASH *is* mirrored
-# since the adversary-library PR.)
-STREAM_TPU_ONLY = frozenset({"STREAM_ATTACK"})
+# since the adversary-library PR.) STREAM_SEARCH is host-orchestration
+# only (tools/advsearch) — it keys no simulated trajectory, so the
+# oracle has nothing to mirror.
+STREAM_TPU_ONLY = frozenset({"STREAM_ATTACK", "STREAM_SEARCH"})
 
 # Streams drawn through the SPEC §2 murmur-style mixer (delivery_u32_*,
 # delay_u32_*), never through the threefry entry points — the two

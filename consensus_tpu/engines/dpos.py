@@ -128,7 +128,7 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
     # broadcast — their chains simply stop growing while crashed. The
     # chain is durable; dpos carries no volatile per-node state, so
     # recovery is plain reachability again.
-    crash_on = cfg.crash_cutoff > 0
+    crash_on = cfg.crash_on
     down = st.down
     if crash_on:
         down, rec, _crashed = crash_transition(
@@ -139,7 +139,7 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
     # chain-wide (like churn), but the draw is keyed (round, producer)
     # so failures correlate with the schedule. miss_cutoff == 0 is a
     # static no-op — the round program is byte-identical.
-    miss_on = cfg.miss_cutoff > 0
+    miss_on = cfg.miss_on
     if miss_on:
         from ..ops.adversary import slot_missed
         miss = slot_missed(seed, r, p, cfg.miss_cutoff)

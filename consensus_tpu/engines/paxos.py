@@ -107,7 +107,7 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False,
     # so no later prepare can be outbid by a forgotten promise — see SPEC
     # §6c); durable: acc_bal/acc_val (the accepted-value history Paxos
     # safety rests on) and the learner state.
-    crash_on = cfg.crash_cutoff > 0
+    crash_on = cfg.crash_on
     down = st.down
     promised0 = st.promised
     if crash_on:

@@ -154,7 +154,7 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
                         cfg.max_delay_rounds)
     # SPEC §6c crash-recover adversary: down nodes neither send nor
     # receive; static no-op when crash_cutoff == 0 (digest-neutral).
-    crash_on = cfg.crash_cutoff > 0
+    crash_on = cfg.crash_on
     down = st.down
     if crash_on:
         down, rec, _crashed = crash_transition(

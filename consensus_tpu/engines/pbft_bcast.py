@@ -379,7 +379,7 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     # compute one of everything instead of two. Bit-identical: streams
     # are counter-based, so not drawing `side` changes nothing else.
     # The general path is untouched.
-    no_part = cfg.partition_cutoff == 0
+    no_part = cfg.no_partition
     bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
     if cfg.max_delay_rounds > 0:
         # SPEC §A.2 delayed retransmission on the per-sender broadcast
@@ -395,7 +395,7 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     # then the state freeze below. (The sorted-space chain needs no up
     # flag: down nodes never broadcast, so they are already outside
     # every honest-broadcasting count mask.)
-    crash_on = cfg.crash_cutoff > 0
+    crash_on = cfg.crash_on
     down = st.down
     if crash_on:
         down, rec, _crashed = crash_transition(

@@ -213,7 +213,7 @@ def pbft_bcast_round_padded(cfg: Config, st: PbftState, r, n_real, f,
     sarange = jnp.arange(S, dtype=jnp.int32)
     real = idx < n_real
 
-    no_part = cfg.partition_cutoff == 0
+    no_part = cfg.no_partition
     bcast = rng.delivery_u32_jnp(seed, ur, uidx, uidx) >= _lt(cfg.drop_cutoff)
     if cfg.max_delay_rounds > 0:
         # SPEC §A.2 on the §6b broadcast key — same absolute (i, i)
@@ -472,7 +472,7 @@ def _fsweep_static(cfg: Config, fs):
     fs = [int(f) for f in fs]
     if not fs or min(fs) < 1:
         raise ValueError(f"f-sweep rungs must be >= 1, got {fs!r}")
-    if cfg.crash_cutoff > 0:
+    if cfg.crash_on:
         # The padded round kernels carry the down mask unchanged — a
         # crashing config would silently simulate zero crashes (the
         # same divergence Config rejects for the cpu engine).

@@ -267,7 +267,7 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
     # SPEC §6c crash-recover adversary. crash_cutoff == 0 is a static
     # config fact: the whole block traces away and the round program is
     # the pre-§6c one (digest-neutral by construction, tests/test_crash.py).
-    crash_on = cfg.crash_cutoff > 0
+    crash_on = cfg.crash_on
     if crash_on:
         down, rec, _crashed = crash_transition(
             seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,

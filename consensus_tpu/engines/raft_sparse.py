@@ -171,7 +171,7 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     ur = jnp.asarray(r, jnp.uint32)
     karange = jnp.arange(L, dtype=jnp.int32)[None, :]
 
-    crash_on = cfg.crash_cutoff > 0
+    crash_on = cfg.crash_on
 
     # SPEC §A.3 targeted attacks — same semantics as the dense kernel
     # (attack == "none" is a static no-op). The sticky mask is defined

@@ -928,21 +928,27 @@ def _empty_io() -> dict[str, int | float]:
             "loads": 0, "load_s": 0.0, "bytes_read": 0}
 
 
-# --- grouped-sweep checkpoint layout (groundwork) ----------------------------
+# --- grouped-sweep checkpoint layout -----------------------------------------
 #
-# ROADMAP "supervisor-driven sweep_chunk recovery": a grouped run is a
-# sequence of independent sub-runs, so its resumable layout is one
-# checkpoint SUBDIRECTORY per group (rotations never collide across
-# groups) plus a manifest naming the groups that finished:
+# A grouped run is a sequence of independent sub-runs, so its resumable
+# layout is one checkpoint SUBDIRECTORY per group (rotations never
+# collide across groups) plus a manifest naming the groups that
+# finished:
 #
-#   root/group_0000/ck.npz (+ rotations)   <- in-progress snapshots
-#   root/group_0001/ck.npz ...
+#   root/group_0000/ck.npz (+ rotations)   <- snapshots; the last one is
+#   root/group_0001/ck.npz ...                the group's FINAL carry
 #   root/groups.json                       <- completed-group manifest
 #
-# run(group_dir=...) writes this layout today; DRIVING a resume from it
-# (skip completed groups, resume the first incomplete one mid-scan) is
-# the supervisor's future PR — which is why checkpoint_path+sweep_chunk
-# stays rejected with a pointer here.
+# run(group_dir=..., resume=True) drives recovery from this layout:
+# each group resumes from its own newest valid rotation — a COMPLETED
+# group's final snapshot (written at next_round == n_rounds as it
+# finished) loads and executes ZERO rounds, so completed groups are
+# skipped at the cost of one load; the first incomplete group resumes
+# mid-scan from its last mid-run snapshot; untouched groups start
+# fresh. Bit-identity is inherited from the ungrouped resume contract
+# (every snapshot validates against its OWN sub-config + seed slice).
+# The manifest cross-checks run identity (config + full-seed-vector
+# CRC) and records which groups completed.
 
 GROUP_MANIFEST_VERSION = 1
 
@@ -998,12 +1004,142 @@ def read_group_manifest(root, cfg: Config, seeds=None):
     return sorted(int(i) for i in doc.get("completed", []))
 
 
+# --- knob-batched generation dispatch (adversary search) --------------------
+#
+# tools/advsearch evaluates a GENERATION of adversary-knob candidates at
+# a time. Each candidate is one vmap lane of one compiled program: the
+# lane's knob cutoffs arrive as traced operands through a
+# core/knobs.KnobView over a shared static base config, so candidates
+# that agree on (protocol, shape, static gates) NEVER recompile — the
+# grouped-sweep axis batches them exactly like sweeps of one config.
+# Fitness reads the lane's flight-recorder series (obs/timeline), so
+# the base config must have telemetry_window > 0.
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _knob_batch_jit(cfg: Config, eng: EngineDef, seeds, kmat):
+    from ..core import knobs as knobslib
+    W = cfg.telemetry_window
+    nw = n_windows(cfg)
+    K = len(eng.telemetry_names)
+    H = len(eng.latency_names)
+
+    def lane(seed, kv):
+        traced = {name: kv[i] for i, name in
+                  enumerate(knobslib.KNOB_COLUMNS)}
+        # attack_target is a node id (indexing/compares against i32
+        # id vectors), not a probability cutoff.
+        traced["attack_target"] = traced["attack_target"].astype(jnp.int32)
+        view = knobslib.KnobView(cfg, **traced)
+        c = eng.make_carry(view, seed)
+        w0 = jnp.zeros((nw, K), jnp.int32)
+        h0 = jnp.zeros((H, flightlib.N_BUCKETS), jnp.int32)
+
+        # No running-totals accumulator here (unlike _chunk_body): the
+        # search reads only the window ring, and totals are its
+        # windows-axis sum anyway.
+        def body(ct, r):
+            c, w, h = ct
+            c2, d, lh = eng.round_flight(view, c, r)
+            wi = r // jnp.int32(W)
+            cur = jax.lax.dynamic_slice(w, (wi, jnp.int32(0)), (1, K))
+            w = jax.lax.dynamic_update_slice(w, cur + d[None, :],
+                                             (wi, jnp.int32(0)))
+            return (c2, w, h + lh), None
+
+        (c, w, h), _ = jax.lax.scan(
+            body, (c, w0, h0),
+            jnp.arange(cfg.n_rounds, dtype=jnp.int32))
+        return c, w, h
+
+    return jax.vmap(lane)(seeds, kmat)
+
+
+def run_knob_batch(cfg: Config, eng: EngineDef, seeds, kmat, *,
+                   generation: int = 0):
+    """Evaluate ``len(seeds)`` adversary-knob candidates as vmap lanes
+    of ONE compiled program and return ``(out, flight)``.
+
+    ``cfg`` is the static base: shapes, protocol dispatch, and —
+    critically — the adversary GATES must be representative for the
+    knobs the lanes vary (``Config.crash_on`` etc.; a gated-off feature
+    is not traced, so a lane's nonzero cutoff for it would be silently
+    ignored — rejected below instead). ``seeds`` is the per-lane u32
+    trajectory seed vector; ``kmat[c]`` is lane ``c``'s knob row in
+    :data:`consensus_tpu.core.knobs.KNOB_COLUMNS` order (u32 cutoffs +
+    attack_target id). A lane whose row equals the base's own cutoffs
+    reproduces a plain ``run`` of that config bit-for-bit
+    (tests/test_advsearch.py).
+
+    ``out`` is ``eng.extract``'s numpy dict batched over lanes;
+    ``flight`` is a ``RunResult.extras["flight"]``-shaped dict (lane ==
+    sweep) ready for :func:`consensus_tpu.obs.timeline.from_flight_dict`
+    — the search's fitness input. Each call is traced as one
+    ``dispatch`` span, which is the acceptance witness that a
+    generation costs one dispatch, not one per candidate.
+    """
+    from ..core import knobs as knobslib
+    if cfg.telemetry_window <= 0:
+        raise ValueError("run_knob_batch needs telemetry_window > 0: "
+                         "candidate fitness is read off the flight "
+                         "recorder series (obs/timeline)")
+    if eng.round_flight is None:
+        raise ValueError(f"engine {eng.name!r} provides no flight "
+                         "recorder (EngineDef.round_flight is None)")
+    seeds = np.asarray(seeds, dtype=np.uint32)
+    kmat = np.asarray(kmat, dtype=np.uint32)
+    if seeds.ndim != 1 or kmat.shape != (seeds.shape[0],
+                                         len(knobslib.KNOB_COLUMNS)):
+        raise ValueError(
+            f"seeds {seeds.shape} / kmat {kmat.shape}: expected [C] and "
+            f"[C, {len(knobslib.KNOB_COLUMNS)}] (KNOB_COLUMNS order)")
+    if seeds.shape[0] != cfg.n_sweeps:
+        raise ValueError(
+            f"{seeds.shape[0]} candidate lanes but cfg.n_sweeps = "
+            f"{cfg.n_sweeps} — the lane axis IS the sweep axis; size "
+            "the base config to the generation's lane count")
+    gates = {"crash_cutoff": cfg.crash_on, "recover_cutoff": cfg.crash_on,
+             "miss_cutoff": cfg.miss_on,
+             "partition_cutoff": not cfg.no_partition,
+             "attack_cutoff": cfg.attack != "none",
+             "attack_target": cfg.attack != "none"}
+    for i, name in enumerate(knobslib.KNOB_COLUMNS):
+        if not gates.get(name, True) \
+                and (kmat[:, i] != np.uint32(getattr(cfg, name))).any():
+            raise ValueError(
+                f"kmat column {name!r} varies from the base value but "
+                "the base config gates that adversary OFF — its "
+                "machinery is untraced and the lane values would be "
+                "silently ignored; make the base gate-representative "
+                "(core/knobs.KnobView)")
+    with obs_trace.span("dispatch", engine=eng.name,
+                        generation=generation,
+                        n_candidates=int(seeds.shape[0])):
+        carry, win, lat = _knob_batch_jit(
+            cfg, eng, jnp.asarray(seeds), jnp.asarray(kmat))
+        out = {k: np.asarray(v) for k, v in eng.extract(carry).items()}
+    warr = np.asarray(win).astype(np.int64)
+    larr = np.asarray(lat).astype(np.int64)
+    flight = {
+        "engine": eng.name,
+        "window_rounds": cfg.telemetry_window,
+        "n_windows": n_windows(cfg),
+        "n_rounds": cfg.n_rounds,
+        "bucket_lo": list(flightlib.BUCKET_LO),
+        "windows": {name: warr[:, :, k]
+                    for k, name in enumerate(eng.telemetry_names)},
+        "latency": {name: larr[:, h, :]
+                    for h, name in enumerate(eng.latency_names)},
+    }
+    return out, flight
+
+
 def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         resume: bool = False, stats: dict | None = None,
         seeds=None, keep_checkpoints: int = 2,
         telemetry: bool = False, fsync_checkpoints: bool = False,
         sync_checkpoints: bool = False,
-        group_dir=None, progress=None) -> dict[str, np.ndarray]:
+        group_dir=None, progress=None,
+        final_checkpoint: bool = False) -> dict[str, np.ndarray]:
     """Run ``cfg.n_rounds`` rounds and return ``eng.extract``'s numpy dict.
 
     With no ``cfg.scan_chunk`` the whole run is one XLA program. With a
@@ -1025,11 +1161,24 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
     ``sync_checkpoints=True`` restores the on-thread save exactly.
 
     ``group_dir`` (sweep_chunk grouping only, exclusive with
-    ``checkpoint_path``) writes the grouped-resume LAYOUT groundwork:
-    each group checkpoints into its own subdirectory
-    (:func:`group_checkpoint_path`) and a manifest of completed groups
-    (:func:`write_group_manifest`) is updated as groups finish.
-    Supervisor-driven resume from that layout is a future PR.
+    ``checkpoint_path``) is the grouped-sweep resumable layout: each
+    group checkpoints into its own subdirectory
+    (:func:`group_checkpoint_path`), writes a FINAL snapshot
+    (``next_round == n_rounds``) as it completes, and a manifest of
+    completed groups (:func:`write_group_manifest`) is updated as
+    groups finish. With ``resume=True`` each group resumes from its own
+    newest valid rotation — completed groups load their final snapshot
+    and execute zero rounds, the first incomplete group resumes
+    mid-scan — and ``stats`` gains ``n_groups`` / ``groups_skipped`` /
+    ``group_start_rounds``. Results are bit-identical to the
+    uninterrupted run (tests/test_ckpt_writer.py;
+    tests/test_resilience.py SIGKILLs it for real).
+
+    ``final_checkpoint=True`` (requires ``checkpoint_path``) writes one
+    last snapshot at ``next_round == n_rounds`` after the scan
+    completes — what makes a finished run's result recoverable without
+    recomputation. The grouped path sets it per group; an already-
+    complete resumed run does not rewrite it.
 
     If ``stats`` is given it is filled with ``start_round`` and
     ``executed_rounds`` so callers can report throughput for the rounds
@@ -1092,15 +1241,9 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         raise ValueError("group_dir and checkpoint_path are exclusive: a "
                          "grouped run snapshots into per-group "
                          "subdirectories of group_dir")
-    if group_dir and resume:
-        # Nothing reads the layout back yet (supervisor-driven grouped
-        # resume is a future PR) — dropping the flag silently would
-        # recompute every group from round 0 while the caller believes
-        # completed groups were skipped.
-        raise ValueError("resume is not implemented for group_dir runs "
-                         "yet (the layout + completed-group manifest are "
-                         "groundwork; supervisor-driven grouped resume is "
-                         "a future PR)")
+    if final_checkpoint and not checkpoint_path:
+        raise ValueError("final_checkpoint=True without a checkpoint_path "
+                         "would be silently ignored (nothing is saved)")
     groups = _sweep_groups(cfg, seeds)
     if group_dir and groups is None:
         raise ValueError("group_dir is the grouped-sweep checkpoint layout "
@@ -1111,18 +1254,29 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         if checkpoint_path:
             # One rotation set cannot hold N groups' snapshots; reject
             # rather than checkpoint only the last group (no silent
-            # ignores). The resumable layout exists as groundwork:
-            # run(group_dir=...) writes per-group subdirectories plus a
-            # completed-group manifest (group_checkpoint_path /
-            # write_group_manifest); supervisor-driven resume from it
-            # is a future PR.
+            # ignores) — group_dir= is the per-group snapshot layout,
+            # and run(group_dir=..., resume=True) drives recovery from
+            # it (skip completed groups, resume the first incomplete
+            # one mid-scan).
             raise ValueError("checkpointing is not supported with "
                              "sweep_chunk; use scan_chunk for mid-run "
                              "snapshots, sweep_chunk=0, or group_dir= for "
                              "the per-group snapshot layout")
         all_seeds = make_seeds(cfg) if seeds is None else np.asarray(seeds)
+        prior: list[int] | None = None
+        if group_dir and resume:
+            # Informational cross-check only: recovery itself rests on
+            # each group's OWN validated snapshots (a completed group's
+            # final snapshot loads at next_round == n_rounds and skips
+            # execution), so a missing/foreign manifest degrades to
+            # recomputation, never to wrong results.
+            prior = read_group_manifest(group_dir, cfg, all_seeds)
+            if prior:
+                _log_ckpt(f"group manifest: groups {prior} recorded "
+                          "complete — resuming from per-group snapshots")
         outs, telems, flights, done = [], [], [], []
         gio = _empty_io() if group_dir else None
+        skipped, starts = 0, []
         for gi, (sub, s) in enumerate(groups):
             gstats: dict = {}
             kw: dict = {}
@@ -1131,10 +1285,14 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
                                                                 gi),
                           keep_checkpoints=keep_checkpoints,
                           fsync_checkpoints=fsync_checkpoints,
-                          sync_checkpoints=sync_checkpoints)
+                          sync_checkpoints=sync_checkpoints,
+                          resume=resume, final_checkpoint=True)
             outs.append(run(sub, eng, mesh=mesh, stats=gstats, seeds=s,
                             telemetry=telemetry, progress=progress, **kw))
             if group_dir:
+                starts.append(gstats.get("start_round", 0))
+                if starts[-1] >= sub.n_rounds:
+                    skipped += 1
                 done.append(gi)
                 write_group_manifest(group_dir, cfg, all_seeds, done,
                                      len(groups))
@@ -1148,6 +1306,12 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
                 stats.update(gstats)
         if group_dir and stats is not None:
             stats["checkpoint_io"] = gio
+            # The grouped-resume audit trail: where each group started
+            # (n_rounds == skipped-as-complete) — the supervisor's
+            # RunReport and the tests read these.
+            stats["n_groups"] = len(groups)
+            stats["groups_skipped"] = skipped
+            stats["group_start_rounds"] = starts
         if telemetry:
             stats["telemetry"] = {
                 k: np.concatenate([t[k] for t in telems])
@@ -1238,6 +1402,20 @@ def run(cfg: Config, eng: EngineDef, *, mesh=None, checkpoint_path=None,
         t0 = time.perf_counter()
         writer.close()
         io["save_s"] += time.perf_counter() - t0
+    if final_checkpoint and start < cfg.n_rounds:
+        # The completed-run snapshot (grouped-resume's skip handle).
+        # Synchronous: the writer is already drained, and nothing
+        # overlaps a run that just ended.
+        snap = (carry, win, lat) if recorder else carry
+        rec = save_checkpoint(checkpoint_path, cfg, snap, cfg.n_rounds,
+                              seeds=np.asarray(seeds),
+                              keep=keep_checkpoints,
+                              fsync=fsync_checkpoints)
+        io["saves"] += 1
+        io["save_s"] += rec["wall_s"]
+        io["pull_s"] += rec["pull_s"]
+        io["write_s"] += rec["write_s"]
+        io["bytes_written"] += rec["bytes"]
 
     if stats is not None:
         stats["executed_rounds"] = cfg.n_rounds - start

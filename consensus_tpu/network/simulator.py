@@ -88,7 +88,12 @@ def run(cfg: Config, warmup: bool = True, warm_cache: bool = False,
         if kw.get("stats") is None:
             kw["stats"] = {}
         stats = kw["stats"]
-        warm = warmup and not engine_kw.get("checkpoint_path")
+        # Snapshot-writing runs (ungrouped checkpoint or the grouped
+        # per-group layout) skip the warmup pass: its hidden execution
+        # would write real snapshots the timed run then resumes from —
+        # measuring a skip, not the simulation.
+        warm = warmup and not (engine_kw.get("checkpoint_path")
+                               or engine_kw.get("group_dir"))
         if warm:
             # Compile + warm; discard result. The pass's dispatches are
             # EXCLUDED from metrics and trace — exported artifacts must

@@ -106,6 +106,7 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                    backoff_cap_s: float = 30.0, backoff_jitter: float = 0.25,
                    jitter_rng=None, deadline_s: float | None = None,
                    fallback_cpu: bool = False, checkpoint_path=None,
+                   group_dir=None,
                    keep_checkpoints: int = 2, fsync_checkpoints: bool = False,
                    sync_checkpoints: bool = False,
                    mesh=None, seeds=None,
@@ -114,6 +115,14 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                    sleep=time.sleep):
     """Run ``cfg`` under supervision; return the :class:`RunResult` with
     ``extras["run_report"]`` filled in.
+
+    ``group_dir`` supervises a GROUPED sweep (``cfg.sweep_chunk``)
+    against the per-group resumable layout: between attempts each
+    completed group is skipped via its final snapshot and the first
+    incomplete group resumes from its own rotation set mid-scan —
+    closing the ROADMAP's "supervisor-driven sweep_chunk recovery"
+    item. Digests are bit-identical to the uninterrupted run
+    (tests/test_resilience.py SIGKILLs a grouped run for real).
 
     ``retries`` bounds re-attempts after transient failures (total
     attempts = retries + 1); between attempts the supervisor sleeps
@@ -191,6 +200,22 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     if checkpoint_path and cfg.engine != "tpu":
         raise ValueError("checkpoint_path is a tpu-engine feature "
                          f"(cfg.engine={cfg.engine!r})")
+    if group_dir:
+        # The grouped-sweep resumable layout (network/runner.py): each
+        # retry resumes per group — completed groups skip via their
+        # final snapshots, the first incomplete group resumes mid-scan
+        # from its own rotation set.
+        if cfg.engine != "tpu":
+            raise ValueError("group_dir is a tpu-engine feature "
+                             f"(cfg.engine={cfg.engine!r})")
+        if checkpoint_path:
+            raise ValueError("group_dir and checkpoint_path are "
+                             "exclusive (the grouped layout snapshots "
+                             "per group)")
+        if not cfg.sweep_chunk or cfg.sweep_chunk >= cfg.n_sweeps:
+            raise ValueError("group_dir needs sweep_chunk grouping "
+                             "(sweep_chunk in (0, n_sweeps)); use "
+                             "checkpoint_path for an ungrouped run")
     if telemetry and cfg.engine != "tpu":
         raise ValueError("telemetry is reduced inside the tpu engine's "
                          f"scan body (cfg.engine={cfg.engine!r} has no "
@@ -223,6 +248,11 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                 kw["telemetry"] = True
             if checkpoint_path:
                 kw.update(checkpoint_path=checkpoint_path, resume=True,
+                          keep_checkpoints=keep_checkpoints,
+                          fsync_checkpoints=fsync_checkpoints,
+                          sync_checkpoints=sync_checkpoints)
+            if group_dir:
+                kw.update(group_dir=group_dir, resume=True,
                           keep_checkpoints=keep_checkpoints,
                           fsync_checkpoints=fsync_checkpoints,
                           sync_checkpoints=sync_checkpoints)

@@ -246,6 +246,36 @@ def derive(tl: Timeline) -> dict[str, Any]:
     return out
 
 
+def lane_fitness(tl: Timeline) -> list[dict[str, Any]]:
+    """Per-sweep fitness signals for the adversary search
+    (tools/advsearch) — one dict per sweep/lane, flattened from
+    :func:`derive` into the four liveness quantities the search scores
+    candidates by (availability floor, stall ratio, bounded recovery,
+    never-recovered), plus the onset/commit context a finding records.
+
+    ``recovery_rounds`` keeps :func:`derive`'s encoding (None = no
+    fault ever fired, -1 = never recovered); ``never_recovered`` lifts
+    the worst outcome into its own flag so a fitness function can
+    weight it without re-decoding.
+    """
+    d = derive(tl)
+    commits = _commit_series(tl)
+    out = []
+    for b in range(tl.n_sweeps):
+        rec = d["recovery_rounds"][b]
+        stalls = d["stall_windows"]["per_sweep"][b]
+        out.append({
+            "availability": d["availability"]["per_sweep"][b],
+            "stall_windows": stalls,
+            "stall_ratio": round(stalls / tl.n_windows, 6),
+            "fault_onset_window": d["fault_onset_window"][b],
+            "recovery_rounds": rec,
+            "never_recovered": rec == -1,
+            "commit_rate": round(float(commits[b].sum()) / tl.n_rounds, 6),
+        })
+    return out
+
+
 def export_metrics(derived: dict[str, Any], registry=None) -> None:
     """Publish the derived liveness metrics as gauges on the process
     metrics registry (default: the one ``--metrics-out`` snapshots), so

@@ -143,6 +143,45 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
 )}
 
 
+# --- discovered scenarios (tools/advsearch) --------------------------------
+#
+# The coverage-guided adversary search distills its oracle-confirmed
+# findings into this same Scenario format; they ship as data
+# (discovered.json next to this module, written by `python -m
+# tools.advsearch distill`) rather than code, so a search run can grow
+# the library without editing source. Each catalog entry embeds the
+# original finding (knobs, fitness metrics, oracle digest — schema
+# tools/validate_trace.py FINDING_FIELDS), and distillation refuses
+# anything that fails its own TimelineBounds on a fresh run or its C++
+# oracle replay (docs/RESILIENCE.md §8).
+
+def _load_discovered() -> dict[str, Scenario]:
+    import json
+    import pathlib
+    path = pathlib.Path(__file__).with_name("discovered.json")
+    if not path.exists():
+        return {}
+    doc = json.loads(path.read_text())
+    out: dict[str, Scenario] = {}
+    for entry in doc.get("scenarios", []):
+        s = entry["scenario"]
+        if s["name"] in SCENARIOS or s["name"] in out:
+            raise ValueError(
+                f"discovered scenario {s['name']!r} collides with an "
+                "already-registered name (discovered.json vs the "
+                "hand-built library)")
+        out[s["name"]] = Scenario(
+            name=s["name"], description=s["description"],
+            protocol=s["protocol"], overrides=dict(s["overrides"]),
+            bounds=TimelineBounds(**s["bounds"]), window=int(s["window"]),
+            min_rounds=int(s["min_rounds"]), tuned=dict(s["tuned"]))
+    return out
+
+
+DISCOVERED: dict[str, Scenario] = _load_discovered()
+SCENARIOS.update(DISCOVERED)
+
+
 def get(name: str) -> Scenario:
     try:
         return SCENARIOS[name]

@@ -353,6 +353,12 @@ SCENARIO_SHAPES = {
     "crash-churn-under-partition": Config(
         protocol="pbft", f=2, n_nodes=7, n_rounds=96, log_capacity=16,
         n_sweeps=2, seed=11),
+    # advsearch-discovered (tools/advsearch, scenarios/discovered.json):
+    # the search's low-drop compound collapse — same tuned shape the
+    # distiller verified at.
+    "discovered-compound-quorum-starvation": Config(
+        protocol="raft", n_nodes=7, n_rounds=96, log_capacity=128,
+        max_entries=96, n_sweeps=2, seed=11),
 }
 
 

@@ -1,0 +1,452 @@
+"""Coverage-guided adversary search (tools/advsearch) + the traced-knob
+generation batching underneath it (core/knobs, runner.run_knob_batch).
+
+Five contracts under test, per the PR's acceptance criteria:
+
+  1. **Lane == production run, bit-for-bit** — a knob-batch lane whose
+     traced knob row equals a real Config's cutoffs computes the
+     identical trajectory (flight series AND decided logs) as a plain
+     ``runner.run`` of that config. This is what makes findings
+     replayable and distilled scenarios faithful.
+  2. **One compiled program per generation per (protocol, shape)** —
+     the fixed-budget smoke search's trace carries exactly one
+     ``dispatch`` span per generation (no per-candidate recompile).
+  3. **Determinism / crash-safe resume** — same search seed ⇒
+     identical generation sequence, coverage map and findings; a
+     search interrupted between (or mid-) generations resumes from the
+     state file to the same findings.
+  4. **Knob-fuzz: no silently-ignored combination** — randomly
+     composed adversary knob dicts either validate into a Config or
+     raise ValueError, never anything else and never silently drop a
+     knob (the PR 10 discipline extended to the whole cross-product
+     the search explores).
+  5. **Distilled catalog** — the committed discovered scenario loads
+     into the library, carries a schema-valid embedded finding, and
+     passes its TimelineBounds through the real ``--scenario`` front
+     door (its oracle digest is pinned in the catalog).
+"""
+import dataclasses
+import json
+import pathlib
+import random
+
+import numpy as np
+import pytest
+
+from consensus_tpu import scenarios
+from consensus_tpu.core import knobs
+from consensus_tpu.core.config import Config
+from consensus_tpu.network import runner, simulator
+
+from tools.advsearch import search as advsearch
+from tools import validate_trace
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _row(cfg):
+    return [int(getattr(cfg, n)) for n in knobs.KNOB_COLUMNS]
+
+
+# --- 1. lane == production run ----------------------------------------------
+
+LANE_CASES = {
+    "dpos": (
+        Config(protocol="dpos", n_nodes=24, n_rounds=64, n_sweeps=2,
+               log_capacity=96, n_candidates=12, n_producers=6, seed=11,
+               drop_rate=0.4, miss_rate=0.2, max_delay_rounds=4,
+               telemetry_window=4),
+        dict(drop_rate=0.1, miss_rate=0.05)),
+    "raft": (
+        Config(protocol="raft", n_nodes=7, n_rounds=64, n_sweeps=2,
+               log_capacity=32, max_entries=24, seed=11, drop_rate=0.3,
+               partition_rate=0.2, churn_rate=0.05, crash_prob=0.1,
+               recover_prob=0.3, max_delay_rounds=4, telemetry_window=4),
+        dict(drop_rate=0.55, crash_prob=0.02, partition_rate=0.0)),
+    "pbft": (
+        Config(protocol="pbft", f=2, n_nodes=7, n_rounds=64, n_sweeps=2,
+               log_capacity=64, seed=11, drop_rate=0.3,
+               partition_rate=0.15, churn_rate=0.03, crash_prob=0.1,
+               recover_prob=0.3, telemetry_window=4),
+        dict(drop_rate=0.45, churn_rate=0.1)),
+    "paxos": (
+        Config(protocol="paxos", n_nodes=9, n_rounds=64, n_sweeps=2,
+               log_capacity=64, seed=11, drop_rate=0.3,
+               partition_rate=0.15, churn_rate=0.03, crash_prob=0.1,
+               recover_prob=0.3, telemetry_window=4),
+        dict(drop_rate=0.5, crash_prob=0.25, recover_prob=0.1)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(LANE_CASES))
+def test_knob_batch_lane_bit_identical_to_production_run(name):
+    """Tentpole soundness: per engine, each vmap lane of the one
+    compiled generation program — knob cutoffs as traced operands —
+    reproduces the plain per-config run bit-for-bit: every flight
+    window series AND every decided-log extract leaf. A lane that
+    zeroes a gated-on knob (partition_rate=0 under a partition-on base)
+    must equal the knob-off config's run."""
+    base, variant = LANE_CASES[name]
+    eng = simulator.engine_def(base)
+    seeds = runner.make_seeds(base)
+    cfgs = [base, dataclasses.replace(base, **variant)]
+    kmat = np.array([_row(c) for c in cfgs], np.uint32)
+    out, flight = runner.run_knob_batch(base, eng, seeds, kmat)
+    for i, cfg in enumerate(cfgs):
+        stats: dict = {}
+        ref = runner.run(
+            dataclasses.replace(cfg, n_sweeps=1, seed=int(seeds[i])),
+            eng, stats=stats, telemetry=True)
+        for cname, v in flight["windows"].items():
+            np.testing.assert_array_equal(
+                v[i], stats["flight"]["windows"][cname][0],
+                err_msg=f"lane {i} window {cname}")
+        for k in ref:
+            np.testing.assert_array_equal(out[k][i], ref[k][0],
+                                          err_msg=f"lane {i} {k}")
+
+
+def test_knob_batch_usage_errors():
+    base, _ = LANE_CASES["raft"]
+    eng = simulator.engine_def(base)
+    seeds = runner.make_seeds(base)
+    kmat = np.array([_row(base)] * 2, np.uint32)
+    with pytest.raises(ValueError, match="telemetry_window"):
+        runner.run_knob_batch(
+            dataclasses.replace(base, telemetry_window=0), eng, seeds,
+            kmat)
+    with pytest.raises(ValueError, match="KNOB_COLUMNS"):
+        runner.run_knob_batch(base, eng, seeds, kmat[:, :3])
+    with pytest.raises(ValueError, match="n_sweeps"):
+        runner.run_knob_batch(base, eng, seeds[:1], kmat[:1])
+    # A lane varying a knob the base gates OFF would be silently
+    # ignored — rejected instead (miss_rate on a raft base).
+    bad = kmat.copy()
+    bad[1, list(knobs.KNOB_COLUMNS).index("miss_cutoff")] = 12345
+    with pytest.raises(ValueError, match="miss_cutoff"):
+        runner.run_knob_batch(base, eng, seeds, bad)
+
+
+def test_knob_view_rejects_unknown_knob():
+    base, _ = LANE_CASES["raft"]
+    with pytest.raises(ValueError, match="unknown traced knobs"):
+        knobs.KnobView(base, n_rounds=5)
+    view = knobs.KnobView(base, drop_cutoff=7)
+    assert view.drop_cutoff == 7
+    assert view.churn_cutoff == base.churn_cutoff   # untraced: static
+    assert view.n_nodes == base.n_nodes             # delegated
+    assert view.crash_on is True                    # gate from base
+
+
+# --- 2/3. search determinism + resume ---------------------------------------
+
+_TINY = dict(search_seed=123, generations=3, population=4, confirm=False)
+
+
+def _space():
+    # The smoke space at a reduced rounds budget for tier-1 speed.
+    sp = advsearch.SPACES["dpos-delivery"]
+    return dataclasses.replace(
+        sp, name="tiny-dpos", base=dataclasses.replace(sp.base,
+                                                       n_rounds=64))
+
+
+def test_search_same_seed_identical_findings(tmp_path, monkeypatch):
+    monkeypatch.setitem(advsearch.SPACES, "tiny-dpos", _space())
+    a = advsearch.run_search(advsearch.SPACES["tiny-dpos"], **_TINY)
+    b = advsearch.run_search(advsearch.SPACES["tiny-dpos"], **_TINY)
+    assert a.to_doc() == b.to_doc()
+    # ... and a different seed explores a different population.
+    c = advsearch.run_search(advsearch.SPACES["tiny-dpos"],
+                             **{**_TINY, "search_seed": 124})
+    assert c.last_eval[0]["knobs"] != a.last_eval[0]["knobs"]
+
+
+def test_search_resume_from_state_converges_to_same_findings(
+        tmp_path, monkeypatch):
+    """Crash-safe resume: a search stopped after generation 1 (its
+    state file is the per-generation manifest) resumes and finishes
+    with EXACTLY the uninterrupted run's state — populations, coverage
+    map, findings, history."""
+    monkeypatch.setitem(advsearch.SPACES, "tiny-dpos", _space())
+    sp = advsearch.SPACES["tiny-dpos"]
+    full = advsearch.run_search(sp, state_dir=tmp_path / "full", **_TINY)
+    part = advsearch.run_search(sp, state_dir=tmp_path / "p",
+                                **{**_TINY, "generations": 2})
+    assert part.generations_done == 2
+    resumed = advsearch.run_search(sp, state_dir=tmp_path / "p",
+                                   resume=True, **_TINY)
+    assert resumed.to_doc() == full.to_doc()
+    # Foreign state identity is refused, not silently restarted —
+    # including a changed fitness parameter (budget_weight shapes every
+    # generation's elite selection; splicing weights would produce a
+    # population no single run can reproduce).
+    with pytest.raises(ValueError, match="different search"):
+        advsearch.run_search(sp, state_dir=tmp_path / "p", resume=True,
+                             **{**_TINY, "search_seed": 999})
+    with pytest.raises(ValueError, match="different search"):
+        advsearch.run_search(sp, state_dir=tmp_path / "p", resume=True,
+                             budget_weight=2.0, **_TINY)
+
+
+def test_search_population_derivation_is_pure():
+    sp = advsearch.SPACES["raft-elections"]
+    prev = [{"candidate": c, "knobs": advsearch._fresh(sp, 5, 0, c),
+             "fitness": float(c), "novel": c == 2}
+            for c in range(6)]
+    p1 = advsearch.next_population(sp, 5, 1, 6, prev)
+    p2 = advsearch.next_population(sp, 5, 1, 6, prev)
+    assert p1 == p2
+    for cand in p1:
+        for k in sp.knobs:
+            assert k.lo <= cand[k.field] <= k.hi
+
+
+# --- 4. knob-fuzz: validate cleanly or raise ValueError ---------------------
+
+# Every adversary-facing Config knob the search (or a user) may
+# compose, with generators spanning valid AND invalid values.
+_FUZZ_FIELDS = {
+    "protocol": lambda r: r.choice(["raft", "pbft", "paxos", "dpos"]),
+    "engine": lambda r: r.choice(["cpu", "tpu"]),
+    "drop_rate": lambda r: r.choice([0.0, 0.3, 1.0]),
+    "partition_rate": lambda r: r.choice([0.0, 0.25, 1.0]),
+    "churn_rate": lambda r: r.choice([0.0, 0.1]),
+    "crash_prob": lambda r: r.choice([0.0, 0.2]),
+    "recover_prob": lambda r: r.choice([0.0, 0.4]),
+    "max_crashed": lambda r: r.choice([0, 2, 100]),
+    "miss_rate": lambda r: r.choice([0.0, 0.2]),
+    "max_delay_rounds": lambda r: r.choice([0, 4, 16, 17, -1]),
+    "attack": lambda r: r.choice(["none", "elect", "sticky", "bogus"]),
+    "attack_rate": lambda r: r.choice([1.0, 0.5]),
+    "attack_target": lambda r: r.choice([0, 3, -2, 99]),
+    "n_byzantine": lambda r: r.choice([0, 1, 50]),
+    "byz_mode": lambda r: r.choice(["silent", "equivocate"]),
+    "fault_model": lambda r: r.choice(["edge", "bcast"]),
+    "telemetry_window": lambda r: r.choice([0, 4]),
+}
+
+
+def test_knob_fuzz_config_validates_or_raises_value_error():
+    """Property test over the adversary knob cross-product: every
+    randomly composed combination either builds a Config (whose knobs
+    then round-trip through to_json — nothing silently dropped) or
+    raises ValueError with a message naming a field. Any OTHER
+    exception is a validation hole."""
+    rng = random.Random(20260803)
+    built = rejected = 0
+    for _ in range(400):
+        kw = {name: gen(rng) for name, gen in _FUZZ_FIELDS.items()
+              if rng.random() < 0.6}
+        if kw.get("protocol") == "pbft":
+            # Keep the shape constraint orthogonal to the knob fuzz
+            # (n_nodes == 3f+1 is a shape rule, not an adversary knob).
+            kw["n_nodes"] = 3 * kw.get("f", 1) + 1
+        try:
+            cfg = Config(**kw)
+        except ValueError as exc:
+            rejected += 1
+            assert str(exc), "ValueError must carry a message"
+            continue
+        built += 1
+        d = json.loads(cfg.to_json())
+        for name, v in kw.items():
+            assert d[name] == v, f"{name} silently altered"
+        assert Config.from_json(cfg.to_json()) == cfg
+    # The generators must actually exercise both outcomes (most random
+    # compositions trip a cross-field rule — that asymmetry is the
+    # no-silent-ignores discipline doing its job).
+    assert built > 25 and rejected > 100, (built, rejected)
+
+
+def test_space_definitions_are_gate_representative():
+    """Every curated space's base really gates ON each searched knob
+    (run_knob_batch would reject the kmat otherwise) and stays within
+    the oracle-replay N <= 2k budget."""
+    for sp in advsearch.SPACES.values():
+        gates = {"crash_prob": sp.base.crash_on,
+                 "recover_prob": sp.base.crash_on,
+                 "miss_rate": sp.base.miss_on,
+                 "partition_rate": not sp.base.no_partition,
+                 "attack_rate": sp.base.attack != "none"}
+        for k in sp.knobs:
+            assert gates.get(k.field, True), (sp.name, k.field)
+        assert sp.base.n_nodes <= 2048
+        # Commit supply outlives the run (fitness-signal hygiene).
+        if sp.base.protocol == "raft":
+            assert sp.base.max_entries >= sp.base.n_rounds
+        elif sp.base.protocol in ("pbft", "paxos", "dpos"):
+            assert sp.base.log_capacity >= sp.base.n_rounds
+
+
+# --- finding schema: producer <-> validator sync ----------------------------
+
+def test_finding_fields_match_validator_registry():
+    assert set(advsearch.FINDING_FIELDS) == validate_trace.FINDING_FIELDS
+
+
+def test_findings_artifact_schema_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setitem(advsearch.SPACES, "tiny-dpos", _space())
+    st = advsearch.run_search(advsearch.SPACES["tiny-dpos"], **_TINY)
+    doc = {"version": 1, "space": st.space,
+           "search_seed": st.search_seed,
+           "generations": st.generations_done, "findings": st.findings}
+    assert validate_trace.validate_finding_doc("mem", doc) == []
+    p = tmp_path / "findings.json"
+    p.write_text(json.dumps(doc))
+    assert validate_trace.validate_finding(p) == []
+    # A drifted key fails loudly.
+    if st.findings:
+        bad = json.loads(json.dumps(doc))
+        bad["findings"][0]["surprise"] = 1
+        assert any("surprise" in e for e in
+                   validate_trace.validate_finding_doc("mem", bad))
+
+
+def test_oracle_confirm_replays_byte_equal():
+    sp = _space()
+    res = advsearch._confirm(sp, dict(miss_rate=0.2, drop_rate=0.4,
+                                      churn_rate=0.02), seed=99)
+    assert res["confirmed"] is True
+    assert len(res["digest"]) == 64
+    # Unmirrored spaces cannot confirm — recorded, not guessed.
+    atk = advsearch.SPACES["raft-attack-elect"]
+    assert advsearch._confirm(atk, dict(attack_rate=0.5), seed=1) == \
+        {"confirmed": None, "reason": "tpu-only"}
+
+
+# --- 5. the committed discovered catalog ------------------------------------
+
+CATALOG = REPO / "consensus_tpu/scenarios/discovered.json"
+
+
+def test_discovered_catalog_registered_and_schema_valid():
+    """The committed catalog (the PR's discovered scenario) loads into
+    the scenario library, embeds a schema-valid oracle-CONFIRMED
+    finding, and names no hand-built scenario."""
+    assert CATALOG.exists(), "discovered.json missing"
+    doc = json.loads(CATALOG.read_text())
+    assert doc["scenarios"], "catalog is empty"
+    for entry in doc["scenarios"]:
+        s = entry["scenario"]
+        assert s["name"] in scenarios.DISCOVERED
+        assert s["name"] in scenarios.SCENARIOS
+        reg = scenarios.get(s["name"])
+        assert reg.protocol == s["protocol"]
+        assert dict(reg.overrides) == dict(s["overrides"])
+        f = entry["finding"]
+        errs = validate_trace.validate_finding_doc("catalog", {
+            "version": 1, "space": f["space"],
+            "search_seed": 0, "generations": f["generation"] + 1,
+            "findings": [f]})
+        assert errs == [], errs
+        assert f["oracle"]["confirmed"] is True
+        # The searched knobs survive verbatim into the overrides —
+        # the scenario replays the finding, not an approximation.
+        for k, v in f["knobs"].items():
+            assert s["overrides"][k] == v
+    # Hand-built names stay hand-built.
+    hand = set(scenarios.SCENARIOS) - set(scenarios.DISCOVERED)
+    assert {e["scenario"]["name"] for e in doc["scenarios"]} \
+        .isdisjoint(hand)
+
+
+def test_discovered_scenario_passes_bounds_via_cli(capsys):
+    """Acceptance: the discovered scenario runs through the real
+    ``--scenario`` front door at its tuned shape and PASSES its
+    TimelineBounds (exit 0, verdict embedded in the report)."""
+    from consensus_tpu import cli
+    name = next(iter(scenarios.DISCOVERED))
+    tuned = scenarios.get(name).tuned
+    rc = cli.main(["--scenario", name,
+                   "--nodes", str(tuned["n_nodes"]),
+                   "--rounds", str(tuned["n_rounds"]),
+                   "--log-capacity", str(tuned["log_capacity"]),
+                   "--max-entries", str(tuned["max_entries"]),
+                   "--sweeps", "2", "--seed", "11", "--platform", "cpu"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["scenario"]["name"] == name
+    assert out["scenario"]["passed"] is True
+
+
+def test_discovered_scenario_differs_from_hand_library():
+    """The discovery is NEW: no hand-built scenario scripts the same
+    (protocol, adversary-knob) composition."""
+    for name in scenarios.DISCOVERED:
+        d = scenarios.get(name)
+        knob_keys = {k for k in d.overrides
+                     if k in advsearch.RATE_CUTOFFS}
+        for hname in set(scenarios.SCENARIOS) - set(scenarios.DISCOVERED):
+            h = scenarios.get(hname)
+            assert (h.protocol, {k: h.overrides.get(k)
+                                 for k in knob_keys}) \
+                != (d.protocol, {k: d.overrides.get(k)
+                                 for k in knob_keys})
+
+
+# --- SIGKILL mid-search resume (slow tier) ----------------------------------
+
+@pytest.mark.slow
+def test_sigkill_mid_search_resumes_to_same_findings(tmp_path):
+    """Acceptance: a real SIGKILL mid-search (delivered as soon as the
+    per-generation state manifest records progress, i.e. somewhere
+    inside a later generation's evaluation) loses at most the
+    interrupted generation; --resume recomputes it from the recorded
+    prefix — pure counter-RNG — and the final state equals the
+    uninterrupted run's, finding-for-finding."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    args = ["--space", "dpos-delivery", "--seed", "123",
+            "--generations", "3", "--population", "4", "--no-confirm"]
+    state = tmp_path / "st"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tools.advsearch", "search",
+         "--state-dir", str(state)] + args,
+        env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    sf = advsearch.state_path(state)
+    deadline = time.monotonic() + 300
+    try:
+        while time.monotonic() < deadline:
+            if sf.exists() and \
+                    json.loads(sf.read_text())["generations_done"] >= 1:
+                break
+            if p.poll() is not None:
+                pytest.fail("search exited before writing generation-1 "
+                            "state")
+            time.sleep(0.05)
+        else:
+            pytest.fail("search never wrote generation-1 state")
+        p.send_signal(signal.SIGKILL)
+    finally:
+        p.wait(timeout=60)
+    assert p.returncode == -signal.SIGKILL
+    done = json.loads(sf.read_text())["generations_done"]
+    assert 1 <= done <= 3
+
+    p2 = subprocess.run(
+        [sys.executable, "-m", "tools.advsearch", "search",
+         "--state-dir", str(state), "--resume"] + args,
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert p2.returncode == 0, p2.stderr
+
+    base = advsearch.run_search(advsearch.SPACES["dpos-delivery"],
+                                search_seed=123, generations=3,
+                                population=4, confirm=False)
+    resumed = json.loads(sf.read_text())
+    assert resumed == base.to_doc()
+
+
+def test_smoke_gate_in_process():
+    """Tier-1 mirror of `make check`'s advsearch layer (same SMOKE
+    budget verbatim — the two cannot drift): the fixed-budget search
+    must witness one `dispatch` span per generation on its own trace
+    and produce a schema-clean findings doc."""
+    from tools.advsearch import __main__ as advcli
+    assert advcli.main(["smoke"]) == 0

@@ -324,18 +324,65 @@ def test_group_dir_layout_manifest_and_bit_identity(tmp_path):
     assert runner.group_checkpoint_path(root, 0).exists()
     assert runner.group_checkpoint_path(root, 1).exists()
     assert runner.read_group_manifest(root, GCFG) == [0, 1]
-    # Aggregated IO across groups: each group saved at r=8, 16.
-    assert stats["checkpoint_io"]["saves"] == 4
+    # Aggregated IO across groups: each group saved mid-run at r=8, 16
+    # plus its FINAL snapshot at r=24 (the grouped-resume skip handle).
+    assert stats["checkpoint_io"]["saves"] == 6
+    assert stats["n_groups"] == 2 and stats["groups_skipped"] == 0
     # Foreign config or seed vector → not-my-manifest, like snapshots.
     assert runner.read_group_manifest(
         root, dataclasses.replace(GCFG, seed=GCFG.seed + 1)) is None
     assert runner.read_group_manifest(
         root, GCFG, seeds=np.asarray([7, 8, 9, 10], np.uint32)) is None
-    # Each group's snapshots validate for ITS sub-config and seed slice.
+    # Each group's newest snapshot is its final carry (next_round ==
+    # n_rounds), validating for ITS sub-config and seed slice.
     groups = runner._sweep_groups(GCFG)
     for gi, (sub, s) in enumerate(groups):
         assert runner.peek_checkpoint(
-            runner.group_checkpoint_path(root, gi), sub, seeds=s) == 16
+            runner.group_checkpoint_path(root, gi), sub, seeds=s) == 24
+
+
+def test_group_dir_resume_skips_completed_and_resumes_mid_scan(tmp_path):
+    """The grouped-resume contract end to end: a finished run resumes
+    by LOADING every group (zero rounds executed); a doctored
+    interrupted state — group 1's final snapshot gone, its r=16
+    mid-run rotation left behind, manifest claiming only group 0 —
+    skips group 0 and resumes group 1 mid-scan. Outputs bit-match the
+    uninterrupted run in both cases."""
+    eng = simulator.engine_def(GCFG)
+    base = runner.run(dataclasses.replace(GCFG, sweep_chunk=0), eng)
+    root = tmp_path / "groups"
+    runner.run(GCFG, eng, group_dir=root)
+
+    # Resume of a COMPLETE run: both groups skip via final snapshots.
+    stats: dict = {}
+    out = runner.run(GCFG, eng, group_dir=root, resume=True, stats=stats)
+    for k in base:
+        np.testing.assert_array_equal(base[k], out[k], err_msg=k)
+    assert stats["groups_skipped"] == 2
+    assert stats["group_start_rounds"] == [24, 24]
+    assert stats["checkpoint_io"]["saves"] == 0  # nothing rewritten
+    assert stats["checkpoint_io"]["loads"] == 2
+
+    # Doctor an interrupted state: group 1 died after its r=16 save.
+    g1 = runner.group_checkpoint_path(root, 1)
+    g1.unlink()                                   # final (r=24) gone
+    runner.rotation_path(g1, 1).rename(g1)        # r=16 mid-run -> latest
+    meta, _ = runner._read_verified(g1)
+    assert meta["next_round"] == 16
+    groups = runner._sweep_groups(GCFG)
+    runner.write_group_manifest(root, GCFG, runner.make_seeds(GCFG), [0],
+                                len(groups))
+    stats = {}
+    out = runner.run(GCFG, eng, group_dir=root, resume=True, stats=stats)
+    for k in base:
+        np.testing.assert_array_equal(base[k], out[k], err_msg=k)
+    assert stats["groups_skipped"] == 1
+    assert stats["group_start_rounds"] == [24, 16]
+    # The recovered run repaired the layout: manifest complete again,
+    # group 1's final snapshot rewritten.
+    assert runner.read_group_manifest(root, GCFG) == [0, 1]
+    sub, s = groups[1]
+    assert runner.peek_checkpoint(g1, sub, seeds=s) == 24
 
 
 def test_group_dir_usage_errors(tmp_path):
@@ -346,11 +393,9 @@ def test_group_dir_usage_errors(tmp_path):
     with pytest.raises(ValueError, match="sweep_chunk"):
         runner.run(dataclasses.replace(GCFG, sweep_chunk=0), eng,
                    group_dir=tmp_path / "g")
-    # resume is not implemented for the grouped layout yet — dropping
-    # the flag silently would recompute every group while the caller
-    # believes completed ones were skipped (no silent ignores).
-    with pytest.raises(ValueError, match="resume"):
-        runner.run(GCFG, eng, group_dir=tmp_path / "g", resume=True)
+    with pytest.raises(ValueError, match="final_checkpoint"):
+        runner.run(dataclasses.replace(GCFG, sweep_chunk=0), eng,
+                   final_checkpoint=True)
 
 
 def test_checkpoint_with_sweep_chunk_points_to_group_dir(tmp_path):

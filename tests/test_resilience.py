@@ -552,3 +552,59 @@ def test_sigkill_without_supervisor_plain_cli_resume(tmp_path):
     assert out["digest"] == base.digest
     # steps cover only the resumed rounds (24..48), not the dead run's.
     assert out["steps"] == CFG.n_sweeps * CFG.n_nodes * (CFG.n_rounds - 24)
+
+
+# --- grouped-sweep SIGKILL resume (slow tier) --------------------------------
+
+GROUPED_CFG = dataclasses.replace(CFG, n_rounds=24, n_sweeps=4,
+                                  sweep_chunk=3)
+
+
+def _spawn_grouped_cli(root, fault_plan=None, extra=()):
+    flags = ["--protocol", "raft", "--nodes", "5", "--rounds", "24",
+             "--sweeps", "4", "--sweep-chunk", "3", "--log-capacity", "16",
+             "--max-entries", "8", "--scan-chunk", "8",
+             "--drop-rate", "0.1", "--churn-rate", "0.05",
+             "--engine", "tpu", "--platform", "cpu",
+             "--group-dir", str(root)] + list(extra)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if fault_plan is not None:
+        env[faults.ENV_VAR] = json.dumps(fault_plan)
+    return subprocess.run(
+        [sys.executable, "-m", "consensus_tpu"] + flags,
+        capture_output=True, text=True, env=env,
+        cwd=pathlib.Path(__file__).resolve().parents[1], timeout=600)
+
+
+@pytest.mark.slow
+def test_sigkill_grouped_sweep_resumes_from_group_manifest(tmp_path):
+    """The grouped-resume acceptance proof: a --group-dir CLI run (4
+    sweeps in groups of 3 -> 2 groups, 3 chunks each) is SIGKILLed by
+    the fault harness during group 1; the supervised re-run reads the
+    group manifest, SKIPS completed group 0 via its final snapshot,
+    resumes group 1 mid-scan from its own rotation set, and the digest
+    is bit-identical to an uninterrupted run."""
+    root = tmp_path / "groups"
+    # Chunks 1-3 are group 0 (rounds 8/16/24 + final snapshot); the
+    # kill lands after group 1's first chunk and its r=8 snapshot.
+    p = _spawn_grouped_cli(root, fault_plan={"kill_after_chunk": 4})
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr)
+    groups = runner._sweep_groups(GROUPED_CFG)
+    sub0, s0 = groups[0]
+    sub1, s1 = groups[1]
+    assert runner.peek_checkpoint(
+        runner.group_checkpoint_path(root, 0), sub0, seeds=s0) == 24
+    assert runner.peek_checkpoint(
+        runner.group_checkpoint_path(root, 1), sub1, seeds=s1) == 8
+    # The manifest recorded exactly the completed group.
+    assert runner.read_group_manifest(root, GROUPED_CFG) == [0]
+
+    base = simulator.run(dataclasses.replace(GROUPED_CFG, sweep_chunk=0),
+                         warmup=False)
+    res = supervisor.supervised_run(GROUPED_CFG, group_dir=root, retries=0)
+    assert res.digest == base.digest
+    # And through the CLI front door (idempotent second recovery).
+    p2 = _spawn_grouped_cli(root, extra=["--retries", "1"])
+    assert p2.returncode == 0, p2.stderr
+    out = json.loads(p2.stdout.strip().splitlines()[-1])
+    assert out["digest"] == base.digest

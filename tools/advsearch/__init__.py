@@ -1,0 +1,35 @@
+"""Coverage-guided adversary search over the fault-knob space.
+
+PR 10 built the attack primitives (crash/slot-miss/delay/targeted
+streams) and PR 9 the judge (flight-recorder timelines); this package
+closes the loop mechanically, the way 2601.00273's hand-derived RAFT
+vulnerability taxonomy suggests a fuzzer should: a host-side search
+loop (seeded counter-RNG sampling + evolutionary mutation + a
+behavior-coverage map) over the adversary knob space, batching each
+generation's candidates onto the grouped-sweep axis as ONE compiled
+XLA program per (protocol, static shape) via
+:func:`consensus_tpu.network.runner.run_knob_batch`, with fitness read
+off the PR 9 timeline metrics (availability floor, stall ratio,
+recovery rounds, never-recovered, DPoS LIB-stall).
+
+Counterexamples the search surfaces ("findings") auto-distill into
+named scenarios in the PR 10 format — Config overrides +
+TimelineBounds, registered in ``consensus_tpu/scenarios`` via the
+committed ``discovered.json`` catalog — and every catalog entry is
+confirmed by a C++ oracle replay at small N before it enters.
+
+    python -m tools.advsearch spaces
+    python -m tools.advsearch search --space dpos-delivery --seed 7 \\
+        --generations 8 --population 16 --state-dir out/
+    python -m tools.advsearch distill --state-dir out/ --finding 0 \\
+        --name my-discovered-attack
+    python -m tools.advsearch smoke
+
+Everything replays exactly from one ``--seed``: candidate sampling,
+mutation, and per-lane trajectory seeds all draw from the registered
+``STREAM_SEARCH`` counter-RNG stream (core/rng.py), and the per-
+generation state file makes an interrupted search resume to the same
+findings (docs/RESILIENCE.md §8).
+"""
+from .search import (SPACES, FINDING_FIELDS, SearchState, Space,  # noqa: F401
+                     run_search, distill, load_state)

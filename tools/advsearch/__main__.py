@@ -1,0 +1,243 @@
+"""CLI for the coverage-guided adversary search (tools/advsearch).
+
+    python -m tools.advsearch spaces
+    python -m tools.advsearch search --space NAME --seed S \\
+        --generations G --population P --state-dir DIR [--resume]
+        [--findings-out findings.json] [--trace-out t.jsonl]
+    python -m tools.advsearch distill --state-dir DIR --finding K \\
+        --name NAME [--catalog PATH]
+    python -m tools.advsearch smoke [--trace-out t.jsonl]
+
+`search` runs on whatever JAX backend is up (the smoke gate pins
+JAX_PLATFORMS=cpu); one generation = one compiled-program dispatch per
+(protocol, shape) — wired as `dispatch` spans into --trace-out, which
+is how the smoke subcommand PROVES the no-per-candidate-recompile
+contract (span count == generation count). `distill` turns a recorded
+finding into a named scenario in consensus_tpu/scenarios/
+discovered.json after re-verifying its bounds end-to-end and its
+C++ oracle replay.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def _log(msg: str) -> None:
+    print(f"advsearch: {msg}", file=sys.stderr, flush=True)
+
+
+def _write_findings(path, st) -> None:
+    doc = {"version": 1, "space": st.space,
+           "search_seed": st.search_seed,
+           "generations": st.generations_done,
+           "findings": st.findings}
+    pathlib.Path(path).write_text(json.dumps(doc, indent=2,
+                                             sort_keys=True))
+    _log(f"{len(st.findings)} findings written to {path}")
+
+
+def cmd_spaces(_args) -> int:
+    from .search import SPACES
+    for name, sp in sorted(SPACES.items()):
+        knobs = ", ".join(f"{k.field}[{k.lo},{k.hi}]" for k in sp.knobs)
+        mirror = "" if sp.mirrored else "  [TPU-only: not distillable]"
+        print(f"{name}: {sp.base.protocol}, N={sp.base.n_nodes}, "
+              f"{sp.base.n_rounds} rounds; knobs {knobs}{mirror}")
+        print(f"  {sp.description}")
+    return 0
+
+
+def cmd_search(args) -> int:
+    from .search import SPACES, run_search
+    try:
+        space = SPACES[args.space]
+    except KeyError:
+        raise SystemExit(f"advsearch: unknown space {args.space!r} "
+                         f"(known: {sorted(SPACES)})")
+    st = run_search(space, search_seed=args.seed,
+                    generations=args.generations,
+                    population=args.population,
+                    state_dir=args.state_dir or None,
+                    resume=args.resume,
+                    budget_weight=args.budget_weight,
+                    confirm=not args.no_confirm, log=_log)
+    if args.findings_out:
+        _write_findings(args.findings_out, st)
+    best = max(st.last_eval, key=lambda e: e["fitness"]) \
+        if st.last_eval else None
+    print(json.dumps({
+        "space": st.space, "search_seed": st.search_seed,
+        "generations": st.generations_done,
+        "population": st.population,
+        "coverage_cells": len(st.coverage),
+        "findings": len(st.findings),
+        "best": None if best is None else
+        {k: best[k] for k in ("knobs", "budget", "severity", "fitness")},
+    }))
+    return 0
+
+
+def cmd_distill(args) -> int:
+    from consensus_tpu import scenarios as scen
+
+    from .search import SPACES, distill, load_state, write_catalog
+    # Reload by recorded identity: the state file names its own space/
+    # seed/population, so distill needs only the directory.
+    doc = json.loads(
+        (pathlib.Path(args.state_dir) / "search_state.json").read_text())
+    st = load_state(args.state_dir, SPACES[doc["space"]],
+                    doc["search_seed"], doc["population"])
+    if st is None:
+        raise SystemExit(f"advsearch: no search state in {args.state_dir}")
+    if not st.findings:
+        raise SystemExit("advsearch: the search recorded no findings — "
+                         "nothing to distill")
+    try:
+        entry = distill(st, args.finding, args.name,
+                        description=args.description)
+    except ValueError as exc:
+        raise SystemExit(f"advsearch: {exc}")
+    catalog = args.catalog or str(
+        pathlib.Path(scen.__file__).with_name("discovered.json"))
+    write_catalog(entry, catalog)
+    _log(f"scenario {args.name!r} entered the catalog at {catalog} "
+         f"(oracle digest {entry['finding']['oracle']['digest'][:16]}…); "
+         f"run it with: consensus-sim --scenario {args.name}")
+    print(json.dumps(entry["scenario"]))
+    return 0
+
+
+# The fixed smoke budget: tiny, seeded, CPU-friendly — the `make
+# advsearch-smoke` gate (tools/check.py) and the tier-1 mirror test
+# reuse these numbers verbatim so the two cannot drift.
+SMOKE = dict(space="dpos-delivery", seed=2026, generations=2,
+             population=6)
+
+
+def cmd_smoke(args) -> int:
+    """A bounded end-to-end search that ASSERTS the one-program-per-
+    generation contract on its own trace: exactly `generations`
+    dispatch spans (and at least one compile under them), then a clean
+    findings schema. Exit nonzero on any violation — a tripwire, not a
+    demo."""
+    import tempfile
+
+    from consensus_tpu.obs import trace as obs_trace
+
+    from .search import SPACES, run_search
+    trace_path = args.trace_out or str(
+        pathlib.Path(tempfile.mkdtemp(prefix="advsmoke")) / "t.jsonl")
+    obs_trace.configure(trace_path)
+    try:
+        st = run_search(SPACES[SMOKE["space"]],
+                        search_seed=SMOKE["seed"],
+                        generations=SMOKE["generations"],
+                        population=SMOKE["population"],
+                        confirm=False, log=_log)
+    finally:
+        obs_trace.close()
+    spans = [json.loads(line) for line in
+             pathlib.Path(trace_path).read_text().splitlines()[1:]]
+    dispatches = [s for s in spans
+                  if s.get("type") == "span" and s["name"] == "dispatch"]
+    if len(dispatches) != SMOKE["generations"]:
+        _log(f"FAIL: {len(dispatches)} dispatch spans for "
+             f"{SMOKE['generations']} generations — candidates did not "
+             "share the generation program")
+        return 1
+    for d in dispatches:
+        if d["attrs"].get("n_candidates") != SMOKE["population"]:
+            _log(f"FAIL: dispatch span carries n_candidates="
+                 f"{d['attrs'].get('n_candidates')}, expected the full "
+                 f"population {SMOKE['population']}")
+            return 1
+    from tools.validate_trace import validate_finding_doc
+    errs = validate_finding_doc("smoke", {
+        "version": 1, "space": st.space, "search_seed": st.search_seed,
+        "generations": st.generations_done, "findings": st.findings})
+    for e in errs:
+        _log(f"FAIL: {e}")
+    if errs:
+        return 1
+    _log(f"smoke ok: {SMOKE['generations']} generations == "
+         f"{len(dispatches)} dispatch spans, {len(st.coverage)} "
+         f"coverage cells, {len(st.findings)} findings (trace: "
+         f"{trace_path})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.advsearch",
+        description="Coverage-guided adversary search over the fault-"
+                    "knob space (docs/RESILIENCE.md §8).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("spaces", help="list the searchable knob spaces")
+
+    s = sub.add_parser("search", help="run (or resume) a search")
+    s.add_argument("--space", required=True)
+    s.add_argument("--seed", type=int, default=0,
+                   help="search seed — every sample/mutation/eval seed "
+                        "derives from it (STREAM_SEARCH), so runs "
+                        "replay exactly")
+    s.add_argument("--generations", type=int, default=8)
+    s.add_argument("--population", type=int, default=16,
+                   help="candidates per generation == vmap lanes of "
+                        "the one compiled generation program")
+    s.add_argument("--state-dir", default="",
+                   help="resumable search state (search_state.json, "
+                        "written atomically per generation)")
+    s.add_argument("--resume", action="store_true",
+                   help="continue from --state-dir's last completed "
+                        "generation (identity-checked: a state file "
+                        "from a different space/seed/population is "
+                        "refused, not silently restarted)")
+    s.add_argument("--budget-weight", type=float, default=0.5,
+                   help="fitness = severity - weight * knob budget: "
+                        "higher weights hunt damage at LOW rates")
+    s.add_argument("--no-confirm", action="store_true",
+                   help="skip the per-finding C++ oracle replay "
+                        "(findings record oracle.confirmed = null; "
+                        "distill will re-run it)")
+    s.add_argument("--findings-out", default="",
+                   help="write the findings artifact (schema-checked "
+                        "by tools/validate_trace.py --finding)")
+    s.add_argument("--trace-out", default="",
+                   help="span JSONL (one `dispatch` span per "
+                        "generation — the no-recompile witness)")
+
+    d = sub.add_parser("distill",
+                       help="turn a recorded finding into a named "
+                            "scenario in the discovered catalog")
+    d.add_argument("--state-dir", required=True)
+    d.add_argument("--finding", type=int, default=0,
+                   help="index into the state's findings list")
+    d.add_argument("--name", required=True,
+                   help="scenario name (collisions with the hand-built "
+                        "library are rejected)")
+    d.add_argument("--description", default="",
+                   help="override the auto-generated description")
+    d.add_argument("--catalog", default="",
+                   help="catalog JSON path (default: the package's "
+                        "consensus_tpu/scenarios/discovered.json)")
+
+    m = sub.add_parser("smoke",
+                       help="fixed tiny-budget search + one-program-"
+                            "per-generation self-check (the `make "
+                            "advsearch-smoke` gate)")
+    m.add_argument("--trace-out", default="")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "search" and args.resume and not args.state_dir:
+        ap.error("--resume needs --state-dir (there is no state to "
+                 "resume without one)")
+    return {"spaces": cmd_spaces, "search": cmd_search,
+            "distill": cmd_distill, "smoke": cmd_smoke}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,710 @@
+"""The advsearch engine: knob spaces, the generation loop, findings.
+
+Determinism contract: every stochastic choice — fresh-sample values,
+parent/knob picks, mutation deltas, per-lane trajectory seeds — is a
+pure counter-RNG draw from ``STREAM_SEARCH`` keyed
+``(generation, subdraw, index)`` under the one ``--seed``, so the same
+seed replays the identical generation sequence, candidate-for-
+candidate, and converges to the identical findings
+(tests/test_advsearch.py). No wall clock, no ``random`` module.
+
+One compiled program per generation per (protocol, shape): a
+generation's candidates are vmap lanes of
+:func:`consensus_tpu.network.runner.run_knob_batch` — knob cutoffs are
+traced operands (core/knobs.KnobView), so only the first generation of
+a space ever compiles; the trace's ``dispatch`` span count equals the
+generation count (the smoke gate counts them).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any
+
+import numpy as np
+
+from consensus_tpu.core import rng
+from consensus_tpu.core.config import Config
+from consensus_tpu.core.knobs import KNOB_COLUMNS
+
+# Searchable rate knobs: Config float field -> its KNOB_COLUMNS cutoff.
+RATE_CUTOFFS = {
+    "drop_rate": "drop_cutoff",
+    "partition_rate": "partition_cutoff",
+    "churn_rate": "churn_cutoff",
+    "crash_prob": "crash_cutoff",
+    "recover_prob": "recover_cutoff",
+    "miss_rate": "miss_cutoff",
+    "attack_rate": "attack_cutoff",
+}
+
+# STREAM_SEARCH subdraw selectors (c0); c1 packs (candidate, knob) as
+# candidate * _IDX_STRIDE + knob_index where both are needed.
+_SUB_FRESH, _SUB_PARENT, _SUB_KNOB, _SUB_MUT, _SUB_SEED, _SUB_MODE = range(6)
+_IDX_STRIDE = 64
+
+# One finding = exactly these keys (the validate_trace --finding
+# tripwire mirrors this tuple as FINDING_FIELDS — lint-synced both ways
+# by tools/lint check `registry`, like the telemetry counters).
+FINDING_FIELDS = ("schema", "space", "protocol", "generation",
+                  "candidate", "eval_seed", "knobs", "budget", "severity",
+                  "fitness", "metrics", "coverage_key", "oracle")
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobRange:
+    field: str   # Config float field (RATE_CUTOFFS key)
+    lo: float
+    hi: float
+
+
+@dataclasses.dataclass(frozen=True)
+class Space:
+    """One searchable fault space: a gate-representative base config
+    (static shape + every searched adversary's gate ON — see
+    core/knobs.KnobView) plus the knob ranges the search varies.
+    ``base.n_sweeps`` is ignored (the lane axis is sized per
+    generation); ``base.telemetry_window`` must be > 0 (fitness reads
+    the flight series). ``mirrored`` says whether every searched knob
+    is implemented by the C++ oracle — findings from unmirrored spaces
+    (SPEC §A.3 targeted attacks) cannot be oracle-confirmed and are
+    refused by :func:`distill`."""
+    name: str
+    description: str
+    base: Config
+    knobs: tuple[KnobRange, ...]
+    mirrored: bool = True
+
+    def __post_init__(self):
+        if self.base.telemetry_window <= 0:
+            raise ValueError(f"space {self.name!r}: base needs "
+                             "telemetry_window > 0 (fitness reads the "
+                             "flight recorder)")
+        for k in self.knobs:
+            if k.field not in RATE_CUTOFFS:
+                raise ValueError(f"space {self.name!r}: {k.field!r} is "
+                                 f"not a searchable rate knob "
+                                 f"({sorted(RATE_CUTOFFS)})")
+            if not 0.0 <= k.lo < k.hi <= 1.0:
+                raise ValueError(f"space {self.name!r}: {k.field} range "
+                                 f"[{k.lo}, {k.hi}] must satisfy "
+                                 "0 <= lo < hi <= 1")
+            rep = getattr(self.base, k.field)
+            if rep <= 0.0 and k.field != "recover_prob":
+                raise ValueError(
+                    f"space {self.name!r}: base.{k.field} = {rep} gates "
+                    "the searched adversary OFF — the base must be "
+                    "gate-representative (core/knobs.KnobView)")
+
+
+# The curated spaces. Shapes stay small (N <= 2k keeps oracle replays
+# seconds-class) but are sized so the COMMIT SUPPLY outlives the run
+# (log capacity / max_entries >= n_rounds where the protocol consumes
+# them): a log that exhausts mid-run caps availability for every
+# candidate alike and drowns the fitness signal in a shape artifact.
+# Static axes (max_delay_rounds depth, attack kind, max_crashed cap)
+# are fixed per space — they select the compiled program, the traced
+# knobs select the lane.
+_ADV = dict(telemetry_window=4, n_rounds=96, seed=0)
+SPACES: dict[str, Space] = {s.name: s for s in (
+    Space(
+        name="dpos-delivery",
+        description="DPoS slot misses composed with heavy lossy/delayed "
+                    "delivery and churn (crash machinery OFF — the "
+                    "hand-built rolling-producer-outage owns that axis): "
+                    "hunting LIB stalls at miss_rate well below 1/3.",
+        base=Config(protocol="dpos", n_nodes=24, log_capacity=96,
+                    n_candidates=12, n_producers=6,
+                    drop_rate=0.3, miss_rate=0.1, max_delay_rounds=4,
+                    churn_rate=0.01, **_ADV),
+        knobs=(KnobRange("miss_rate", 0.02, 0.33),
+               KnobRange("drop_rate", 0.05, 0.60),
+               KnobRange("churn_rate", 0.0, 0.10))),
+    Space(
+        name="raft-elections",
+        description="Raft liveness under composed loss/partition/churn/"
+                    "crash with bounded delayed retransmissions.",
+        base=Config(protocol="raft", n_nodes=7, log_capacity=128,
+                    max_entries=96, drop_rate=0.3, partition_rate=0.1,
+                    churn_rate=0.02, crash_prob=0.1, recover_prob=0.3,
+                    max_crashed=3, max_delay_rounds=4, **_ADV),
+        knobs=(KnobRange("drop_rate", 0.05, 0.60),
+               KnobRange("partition_rate", 0.0, 0.40),
+               KnobRange("churn_rate", 0.0, 0.15),
+               KnobRange("crash_prob", 0.0, 0.30),
+               KnobRange("recover_prob", 0.05, 0.50))),
+    Space(
+        name="pbft-quorum",
+        description="PBFT view-change/quorum suppression under crash "
+                    "churn, partitions and loss.",
+        base=Config(protocol="pbft", f=2, n_nodes=7, log_capacity=96,
+                    drop_rate=0.3, partition_rate=0.1, churn_rate=0.02,
+                    crash_prob=0.1, recover_prob=0.3, max_crashed=2,
+                    max_delay_rounds=4, **_ADV),
+        knobs=(KnobRange("drop_rate", 0.05, 0.60),
+               KnobRange("partition_rate", 0.0, 0.40),
+               KnobRange("churn_rate", 0.0, 0.15),
+               KnobRange("crash_prob", 0.0, 0.30),
+               KnobRange("recover_prob", 0.05, 0.50))),
+    Space(
+        name="paxos-slots",
+        description="Paxos learning stalls under composed loss/"
+                    "partition/churn/crash.",
+        base=Config(protocol="paxos", n_nodes=9, log_capacity=96,
+                    drop_rate=0.3, partition_rate=0.1, churn_rate=0.02,
+                    crash_prob=0.1, recover_prob=0.3, max_crashed=3,
+                    max_delay_rounds=4, **_ADV),
+        knobs=(KnobRange("drop_rate", 0.05, 0.60),
+               KnobRange("partition_rate", 0.0, 0.40),
+               KnobRange("churn_rate", 0.0, 0.15),
+               KnobRange("crash_prob", 0.0, 0.30),
+               KnobRange("recover_prob", 0.05, 0.50))),
+    Space(
+        name="raft-attack-elect",
+        description="SPEC §A.3 repeated election disruption: how low "
+                    "an attack_rate still denies liveness. TPU-only "
+                    "(the oracle does not mirror targeted attacks) — "
+                    "findings cannot enter the distilled catalog.",
+        base=Config(protocol="raft", n_nodes=7, log_capacity=128,
+                    max_entries=96, drop_rate=0.05, attack="elect",
+                    attack_rate=0.9, **_ADV),
+        knobs=(KnobRange("attack_rate", 0.2, 1.0),
+               KnobRange("drop_rate", 0.0, 0.30)),
+        mirrored=False),
+)}
+
+
+# --- counter-RNG helpers ----------------------------------------------------
+
+def _u01(seed: int, gen: int, sub: int, idx: int) -> float:
+    return float(rng.random_u32_np(seed, rng.STREAM_SEARCH,
+                                   np.uint32(gen), np.uint32(sub),
+                                   np.uint32(idx))) / 2.0 ** 32
+
+
+def _rate(v: float) -> float:
+    # 4-decimal knob values: short scenario overrides, identical
+    # cutoffs between the lane encoding and a distilled Config replay.
+    return round(v, 4)
+
+
+def eval_seed(search_seed: int, gen: int, cand: int) -> int:
+    """Per-(generation, candidate) trajectory seed — recorded in each
+    finding so a replay is exact."""
+    return int(rng.random_u32_np(search_seed, rng.STREAM_SEARCH,
+                                 np.uint32(gen), np.uint32(_SUB_SEED),
+                                 np.uint32(cand)))
+
+
+# --- candidates and generations ---------------------------------------------
+
+def _fresh(space: Space, seed: int, gen: int, cand: int) -> dict[str, float]:
+    out = {}
+    for ki, k in enumerate(space.knobs):
+        u = _u01(seed, gen, _SUB_FRESH, cand * _IDX_STRIDE + ki)
+        out[k.field] = _rate(k.lo + u * (k.hi - k.lo))
+    return out
+
+
+def _mutate(space: Space, seed: int, gen: int, cand: int,
+            parent: dict[str, float]) -> dict[str, float]:
+    ki = int(_u01(seed, gen, _SUB_KNOB, cand) * len(space.knobs))
+    ki = min(ki, len(space.knobs) - 1)
+    k = space.knobs[ki]
+    u = _u01(seed, gen, _SUB_MUT, cand * _IDX_STRIDE + ki)
+    step = (2.0 * u - 1.0) * 0.3 * (k.hi - k.lo)
+    child = dict(parent)
+    child[k.field] = _rate(min(k.hi, max(k.lo, parent[k.field] + step)))
+    return child
+
+
+def next_population(space: Space, seed: int, gen: int, population: int,
+                    prev_eval: list[dict] | None,
+                    fresh_frac: float = 0.25) -> list[dict[str, float]]:
+    """Generation ``gen``'s candidate knob dicts — a pure function of
+    (space, seed, gen, previous generation's evaluation), which is what
+    makes a SIGKILLed search recompute the interrupted generation
+    exactly on resume.
+
+    Gen 0 is all fresh samples. Later generations keep the elite
+    quartile (by fitness, ties broken candidate-index-ascending) plus
+    every candidate that opened a NEW coverage cell last generation,
+    then fill with mutations of elite parents and ``fresh_frac`` fresh
+    samples.
+    """
+    if gen == 0 or not prev_eval:
+        return [_fresh(space, seed, gen, c) for c in range(population)]
+    ranked = sorted(prev_eval, key=lambda e: (-e["fitness"],
+                                              e["candidate"]))
+    n_elite = max(1, population // 4)
+    elites = ranked[:n_elite]
+    novel = [e for e in prev_eval
+             if e.get("novel") and e not in elites]
+    keep = (elites + novel)[:max(1, population // 2)]
+    pop = [dict(e["knobs"]) for e in keep]
+    for c in range(len(pop), population):
+        if _u01(seed, gen, _SUB_MODE, c) < fresh_frac:
+            pop.append(_fresh(space, seed, gen, c))
+        else:
+            pick = int(_u01(seed, gen, _SUB_PARENT, c) * len(keep))
+            parent = keep[min(pick, len(keep) - 1)]["knobs"]
+            pop.append(_mutate(space, seed, gen, c, parent))
+    return pop
+
+
+def knob_row(space: Space, knobs: dict[str, float]) -> list[int]:
+    """A candidate's u32 kmat row (KNOB_COLUMNS order): the base
+    config's cutoffs with the searched knobs' cutoffs substituted —
+    exactly what ``dataclasses.replace(base, **knobs)`` would derive,
+    so a finding's replay config is cutoff-identical to its lane."""
+    cfg = dataclasses.replace(space.base, **knobs)
+    return [int(getattr(cfg, name)) for name in KNOB_COLUMNS]
+
+
+# --- fitness ----------------------------------------------------------------
+
+def budget_of(space: Space, knobs: dict[str, float]) -> float:
+    """Normalized attack budget in [0, 1]: mean knob position within
+    its range (recover_prob inverted — LOW recovery is the expensive
+    direction). Severity per unit budget is the search's 'surprise'
+    signal: damage at low rates is what the hand-built library misses."""
+    parts = []
+    for k in space.knobs:
+        x = (knobs[k.field] - k.lo) / (k.hi - k.lo)
+        parts.append(1.0 - x if k.field == "recover_prob" else x)
+    return round(sum(parts) / len(parts), 6)
+
+
+def severity_of(metrics: dict[str, Any]) -> float:
+    """Scalar liveness damage from one lane's fitness signals
+    (obs/timeline.lane_fitness [+ lib_ratio for dpos])."""
+    sev = (1.0 - metrics["availability"]) + 0.5 * metrics["stall_ratio"]
+    if metrics["never_recovered"]:
+        sev += 1.0
+    lib = metrics.get("lib_ratio")
+    if lib is not None:
+        sev += 1.0 - lib
+    return round(sev, 6)
+
+
+def coverage_key(metrics: dict[str, Any]) -> str:
+    """Behavior-coverage cell: deciles of availability / stall ratio /
+    LIB ratio plus the never-recovered flag. A candidate landing in an
+    unseen cell is NOVEL — it survives into the next generation even
+    with mediocre fitness, which is what makes the search
+    coverage-guided rather than pure hill-climbing."""
+    dec = lambda x: min(9, int(x * 10))  # noqa: E731
+    lib = metrics.get("lib_ratio")
+    return "a{}s{}n{}l{}".format(
+        dec(metrics["availability"]), dec(metrics["stall_ratio"]),
+        int(metrics["never_recovered"]),
+        "-" if lib is None else dec(lib))
+
+
+# --- search state -----------------------------------------------------------
+
+STATE_VERSION = 1
+
+
+@dataclasses.dataclass
+class SearchState:
+    space: str
+    search_seed: int
+    population: int
+    # Fitness/threshold parameters are search IDENTITY too:
+    # budget_weight shapes every generation's elite selection, the
+    # thresholds decide what becomes a finding — resuming under
+    # different values would splice two searches no single run can
+    # reproduce (load_state refuses the mismatch).
+    params: dict = dataclasses.field(default_factory=dict)
+    generations_done: int = 0
+    coverage: dict = dataclasses.field(default_factory=dict)
+    findings: list = dataclasses.field(default_factory=list)
+    last_eval: list = dataclasses.field(default_factory=list)
+    history: list = dataclasses.field(default_factory=list)
+
+    def to_doc(self) -> dict:
+        sp = SPACES[self.space]
+        return {"version": STATE_VERSION, "space": self.space,
+                "search_seed": self.search_seed,
+                "population": self.population, "params": self.params,
+                "base_config": json.loads(sp.base.to_json()),
+                "knobs": [[k.field, k.lo, k.hi] for k in sp.knobs],
+                "generations_done": self.generations_done,
+                "coverage": self.coverage, "findings": self.findings,
+                "last_eval": self.last_eval, "history": self.history}
+
+
+def state_path(state_dir) -> pathlib.Path:
+    return pathlib.Path(state_dir) / "search_state.json"
+
+
+def save_state(state_dir, st: SearchState) -> None:
+    """Atomic per-generation state write (tmp + rename), the search's
+    analog of the runner's group manifest: a SIGKILL at any instant
+    leaves the last completed generation durably recorded."""
+    p = state_path(state_dir)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp.json")
+    tmp.write_text(json.dumps(st.to_doc(), indent=2, sort_keys=True))
+    tmp.replace(p)
+
+
+def load_state(state_dir, space: Space, search_seed: int,
+               population: int,
+               params: dict | None = None) -> SearchState | None:
+    """The resumable state for exactly (space, seed, population,
+    fitness params) — or None when absent. A state file for a
+    DIFFERENT search identity is an error, not a silent restart:
+    resuming it would splice two unrelated searches' populations.
+    ``params=None`` accepts whatever the state recorded (read-only
+    consumers like ``distill``, which never advance the search)."""
+    p = state_path(state_dir)
+    if not p.exists():
+        return None
+    doc = json.loads(p.read_text())
+    if doc.get("version") != STATE_VERSION:
+        raise ValueError(f"{p}: state version {doc.get('version')!r} != "
+                         f"{STATE_VERSION}")
+    ident = {"space": space.name, "search_seed": search_seed,
+             "population": population,
+             "base_config": json.loads(space.base.to_json()),
+             "knobs": [[k.field, k.lo, k.hi] for k in space.knobs]}
+    if params is not None:
+        ident["params"] = params
+    got = {k: doc.get(k) for k in ident}
+    if got != ident:
+        diff = [k for k in ident if got[k] != ident[k]]
+        raise ValueError(
+            f"{p}: existing search state belongs to a different search "
+            f"({', '.join(diff)} differ) — pass a fresh --state-dir or "
+            "the original space/seed/population/fitness parameters")
+    return SearchState(space=space.name, search_seed=search_seed,
+                       population=population, params=doc.get("params", {}),
+                       generations_done=doc["generations_done"],
+                       coverage=doc["coverage"], findings=doc["findings"],
+                       last_eval=doc["last_eval"], history=doc["history"])
+
+
+# --- the generation loop ----------------------------------------------------
+
+def _lane_metrics(space: Space, out: dict, flight: dict) -> list[dict]:
+    from consensus_tpu.obs import timeline as obs_timeline
+    tl = obs_timeline.from_flight_dict(flight)
+    mets = obs_timeline.lane_fitness(tl)
+    if space.base.protocol == "dpos":
+        from consensus_tpu.engines.dpos import lib_index
+        lib = np.asarray(lib_index(out["chain_p"], out["chain_len"],
+                                   space.base.n_candidates,
+                                   space.base.n_producers), np.int64)
+        head = np.asarray(out["chain_len"], np.int64)
+        for b, m in enumerate(mets):
+            m["lib_ratio"] = round(
+                float((lib[b] + 1).mean())
+                / max(1.0, float(head[b].mean())), 6)
+    return mets
+
+
+def _dispatch(cfg, eng, seeds, kmat, *, generation: int, retries: int = 2,
+              sleep=None):
+    """One generation dispatch under bounded transient-retry — the
+    supervisor's failure taxonomy (network/supervisor.is_transient),
+    minus resume (a generation is atomic; its inputs replay exactly)."""
+    import time as _time
+
+    from consensus_tpu.network import runner, supervisor
+    sleep = _time.sleep if sleep is None else sleep
+    for attempt in range(retries + 1):
+        try:
+            return runner.run_knob_batch(cfg, eng, seeds, kmat,
+                                         generation=generation)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if attempt >= retries or not supervisor.is_transient(exc):
+                raise
+            sleep(0.5 * 2 ** attempt)
+    raise AssertionError("unreachable")
+
+
+def run_search(space: Space, *, search_seed: int, generations: int,
+               population: int, state_dir=None, resume: bool = False,
+               budget_weight: float = 0.5, max_budget: float = 0.85,
+               max_availability: float = 0.7, max_lib_ratio: float = 0.5,
+               confirm: bool = True, log=None) -> SearchState:
+    """Run (or resume) a search; returns the final state.
+
+    A FINDING is a candidate whose lane shows real liveness damage —
+    ``availability <= max_availability``, or never-recovered, or (DPoS)
+    ``lib_ratio <= max_lib_ratio`` — at attack budget
+    ``<= max_budget`` (full-throttle knobs stalling a protocol is not
+    news). With ``confirm`` (mirrored spaces only), each finding's
+    trajectory is immediately replayed on the C++ oracle and the
+    decided-log digests byte-compared — ``finding["oracle"]`` records
+    ``{"confirmed": true, "digest": ...}``; unmirrored spaces record
+    ``{"confirmed": null, "reason": "tpu-only"}``.
+    """
+    import dataclasses as _dc
+
+    from consensus_tpu.network import simulator
+
+    log = log or (lambda *_: None)
+    params = {"budget_weight": budget_weight, "max_budget": max_budget,
+              "max_availability": max_availability,
+              "max_lib_ratio": max_lib_ratio, "confirm": bool(confirm)}
+    st = None
+    if state_dir is not None and resume:
+        st = load_state(state_dir, space, search_seed, population,
+                        params=params)
+        if st is not None:
+            log(f"resuming at generation {st.generations_done} "
+                f"({len(st.findings)} findings so far)")
+    if st is None:
+        st = SearchState(space=space.name, search_seed=search_seed,
+                         population=population, params=params)
+
+    base = _dc.replace(space.base, n_sweeps=population)
+    eng = simulator.engine_def(base)
+    for gen in range(st.generations_done, generations):
+        pop = next_population(space, search_seed, gen, population,
+                              st.last_eval or None)
+        seeds = np.array([eval_seed(search_seed, gen, c)
+                          for c in range(population)], np.uint32)
+        kmat = np.array([knob_row(space, kn) for kn in pop], np.uint32)
+        out, flight = _dispatch(base, eng, seeds, kmat, generation=gen)
+        mets = _lane_metrics(space, out, flight)
+
+        evals, new_cells = [], 0
+        for c, (kn, m) in enumerate(zip(pop, mets)):
+            bud = budget_of(space, kn)
+            sev = severity_of(m)
+            fit = round(sev - budget_weight * bud, 6)
+            key = coverage_key(m)
+            novel = key not in st.coverage
+            if novel:
+                new_cells += 1
+                st.coverage[key] = {"generation": gen, "candidate": c,
+                                    "knobs": kn, "severity": sev}
+            rec = {"candidate": c, "knobs": kn, "budget": bud,
+                   "severity": sev, "fitness": fit, "novel": novel,
+                   "metrics": m}
+            evals.append(rec)
+            hurt = (m["availability"] <= max_availability
+                    or m["never_recovered"]
+                    or (m.get("lib_ratio") is not None
+                        and m["lib_ratio"] <= max_lib_ratio))
+            # One finding per coverage cell: `novel` bounds the archive
+            # by the behavior map (and with it the oracle-replay cost),
+            # and keeps the findings DIVERSE — thousands of near-copies
+            # of one stall are one discovery, not thousands.
+            if hurt and bud <= max_budget and novel:
+                finding = {
+                    "schema": 1, "space": space.name,
+                    "protocol": space.base.protocol, "generation": gen,
+                    "candidate": c, "eval_seed": int(seeds[c]),
+                    "knobs": kn, "budget": bud, "severity": sev,
+                    "fitness": fit, "metrics": m, "coverage_key": key,
+                    "oracle": _confirm(space, kn, int(seeds[c]))
+                    if confirm else {"confirmed": None,
+                                     "reason": "skipped"},
+                }
+                st.findings.append(finding)
+        st.last_eval = evals
+        st.generations_done = gen + 1
+        best = max(evals, key=lambda e: e["fitness"])
+        st.history.append({"generation": gen,
+                           "best_fitness": best["fitness"],
+                           "best_severity": best["severity"],
+                           "new_cells": new_cells,
+                           "findings_total": len(st.findings)})
+        log(f"gen {gen}: best fitness {best['fitness']:.3f} "
+            f"(severity {best['severity']:.3f} at budget "
+            f"{best['budget']:.2f}), {new_cells} new coverage cells, "
+            f"{len(st.findings)} findings total")
+        if state_dir is not None:
+            save_state(state_dir, st)
+    return st
+
+
+def replay_config(space: Space, knobs: dict[str, float],
+                  seed: int) -> Config:
+    """The exact single-trajectory Config a finding's lane simulated —
+    what the oracle replay and a distilled scenario re-run execute."""
+    return dataclasses.replace(space.base, n_sweeps=1, seed=seed,
+                               **knobs)
+
+
+def _confirm(space: Space, knobs: dict[str, float], seed: int) -> dict:
+    """Oracle replay of one finding at its own (small) shape: run the
+    trajectory on both engines and byte-compare decided-log digests.
+    The flight recorder is digest-neutral, so it is dropped for both
+    sides (Config rejects it on engine='cpu')."""
+    import dataclasses as _dc
+
+    from consensus_tpu.network import simulator
+    if not space.mirrored:
+        return {"confirmed": None, "reason": "tpu-only"}
+    if space.base.n_nodes > 2048:
+        return {"confirmed": None, "reason": "n_nodes > 2048"}
+    cfg = _dc.replace(replay_config(space, knobs, seed),
+                      telemetry_window=0)
+    tpu = simulator.run(cfg, warmup=False)
+    cpu = simulator.run(_dc.replace(cfg, engine="cpu"), warmup=False)
+    ok = tpu.payload == cpu.payload
+    return {"confirmed": bool(ok), "digest": tpu.digest,
+            **({} if ok else {"oracle_digest": cpu.digest})}
+
+
+# --- distillation -----------------------------------------------------------
+
+def _bounds_from_metrics(m: dict[str, Any]) -> dict[str, Any]:
+    """TimelineBounds for a distilled scenario, with slack around the
+    observed lane so the assertion is a stable liveness SHAPE, not an
+    exact-replay tripwire: the dip bound sits well above the observed
+    availability, the floor well below, and never-recovered findings
+    assert stalls instead of bounded recovery."""
+    avail = m["availability"]
+    # The slack widths absorb seed-to-seed variance (the finding's lane
+    # is ONE trajectory; the scenario asserts a shape across fresh
+    # seeds) while keeping the dip claim far from the healthy ~1.0.
+    b: dict[str, Any] = {
+        "max_availability": round(min(0.99, avail + 0.4), 3),
+        "min_availability": round(max(0.02, avail - 0.3), 3),
+    }
+    if m["stall_windows"] > 0:
+        b["min_stall_windows"] = max(1, m["stall_windows"] // 3)
+    if not m["never_recovered"] and m["recovery_rounds"] is not None:
+        b["max_recovery_rounds"] = int(m["recovery_rounds"] * 4)
+    if m.get("lib_ratio") is not None:
+        b["max_lib_ratio"] = round(min(0.95, m["lib_ratio"] + 0.2), 3)
+    return b
+
+
+# Shape fields a scenario's `tuned` reference records, per protocol —
+# the same fields the hand-built library pins.
+_TUNED_FIELDS = {
+    "raft": ("n_nodes", "n_rounds", "log_capacity", "max_entries"),
+    "pbft": ("n_nodes", "f", "n_rounds", "log_capacity"),
+    "paxos": ("n_nodes", "n_rounds", "log_capacity"),
+    "dpos": ("n_nodes", "n_rounds", "log_capacity", "n_candidates",
+             "n_producers"),
+}
+
+
+def distill(st: SearchState, finding_index: int, name: str,
+            description: str = "") -> dict:
+    """One finding -> a catalog entry: scenario overrides (the knob
+    floats plus the space's static adversary axes), TimelineBounds with
+    slack, the tuned shape, and the embedded finding record. The entry
+    is only returned after (1) the scenario PASSES its own bounds in a
+    fresh end-to-end run and (2) the oracle replay is confirmed —
+    nothing unverified enters the catalog.
+    """
+    import dataclasses as _dc
+
+    from consensus_tpu import scenarios as scen
+    from consensus_tpu.network import simulator
+
+    space = SPACES[st.space]
+    try:
+        f = st.findings[finding_index]
+    except IndexError:
+        raise ValueError(f"finding index {finding_index} out of range "
+                         f"(state holds {len(st.findings)})") from None
+    if not space.mirrored:
+        raise ValueError(
+            f"space {space.name!r} searches TPU-only knobs (SPEC §A.3 "
+            "targeted attacks) — its findings cannot be oracle-"
+            "confirmed, so they cannot enter the distilled catalog")
+    oracle = f["oracle"]
+    if oracle.get("confirmed") is None:
+        oracle = _confirm(space, f["knobs"], f["eval_seed"])
+    if not oracle.get("confirmed"):
+        raise ValueError(f"finding {finding_index}: oracle replay did "
+                         f"not confirm ({oracle}) — refusing to distill")
+
+    overrides = dict(sorted(f["knobs"].items()))
+    # Static adversary axes of the space that shaped the lane (a
+    # scenario override list must reproduce the attack, not just the
+    # searched knobs).
+    base = space.base
+    if base.max_delay_rounds:
+        overrides["max_delay_rounds"] = base.max_delay_rounds
+    if base.max_crashed and "crash_prob" in overrides:
+        overrides["max_crashed"] = base.max_crashed
+    for k in RATE_CUTOFFS:
+        if k == "attack_rate" and base.attack == "none":
+            continue  # a bare attack_rate is rejected by Config
+        if k == "recover_prob":
+            if "crash_prob" in overrides and k not in overrides:
+                overrides[k] = getattr(base, k)
+        elif k not in overrides and getattr(base, k) > 0:
+            overrides[k] = getattr(base, k)
+
+    if not description:
+        m = f["metrics"]
+        bits = [f"{k}={v}" for k, v in sorted(f["knobs"].items())]
+        description = (
+            f"advsearch-discovered ({space.name}, seed "
+            f"{st.search_seed}, gen {f['generation']}): "
+            f"{', '.join(bits)} -> availability "
+            f"{m['availability']:.3f}, {m['stall_windows']} stall "
+            f"windows" + (f", LIB ratio {m['lib_ratio']:.3f}"
+                          if m.get("lib_ratio") is not None else "")
+            + ". Confirmed by a C++ oracle replay.")
+
+    scenario = {
+        "name": name, "description": description,
+        "protocol": base.protocol, "overrides": overrides,
+        "bounds": _bounds_from_metrics(f["metrics"]),
+        "window": base.telemetry_window, "min_rounds": 64,
+        "tuned": {k: getattr(base, k)
+                  for k in _TUNED_FIELDS[base.protocol]},
+    }
+    entry = {"scenario": scenario,
+             "finding": {**{k: f[k] for k in FINDING_FIELDS
+                            if k != "oracle"}, "oracle": oracle}}
+
+    # Verify end-to-end before it can enter the catalog: build the
+    # Scenario object, apply it to the tuned shape, run, judge.
+    s = scen.Scenario(
+        name=name, description=description, protocol=base.protocol,
+        overrides=overrides,
+        bounds=scen.TimelineBounds(**scenario["bounds"]),
+        window=scenario["window"], min_rounds=scenario["min_rounds"],
+        tuned=scenario["tuned"])
+    shape = _dc.replace(
+        Config(protocol=base.protocol, engine="tpu",
+               **{k: v for k, v in scenario["tuned"].items()}),
+        n_sweeps=2, seed=base.seed)
+    res = simulator.run(scen.apply(shape, s), warmup=False,
+                        telemetry=True, stats={})
+    verdict = scen.evaluate(s, res)
+    if not verdict["passed"]:
+        raise ValueError(
+            f"distilled scenario {name!r} FAILED its own bounds on a "
+            f"fresh run at the tuned shape: {verdict['checks']} — not "
+            "entering the catalog")
+    entry["scenario"]["verified_availability"] = verdict["availability"]
+    return entry
+
+
+def write_catalog(entry: dict, catalog_path) -> None:
+    """Append (or replace by name) one distilled entry in the catalog
+    JSON the scenario library loads (consensus_tpu/scenarios/
+    discovered.json). Atomic, sorted by name."""
+    from consensus_tpu import scenarios as scen
+    p = pathlib.Path(catalog_path)
+    doc = {"version": 1, "scenarios": []}
+    if p.exists():
+        doc = json.loads(p.read_text())
+    name = entry["scenario"]["name"]
+    if name in scen.SCENARIOS and name not in {
+            e["scenario"]["name"] for e in doc["scenarios"]}:
+        raise ValueError(f"scenario name {name!r} collides with the "
+                         "hand-built library — pick another --name")
+    doc["scenarios"] = [e for e in doc["scenarios"]
+                        if e["scenario"]["name"] != name] + [entry]
+    doc["scenarios"].sort(key=lambda e: e["scenario"]["name"])
+    tmp = p.with_suffix(".tmp.json")
+    tmp.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    tmp.replace(p)

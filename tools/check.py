@@ -18,6 +18,12 @@ Layers (docs/STATIC_ANALYSIS.md):
   ruff   — generic Python lint (pyproject.toml)        [gated]
   mypy   — typed-perimeter type check (pyproject.toml) [gated]
   tidy   — clang-tidy over cpp/ (`make -C cpp tidy`)   [gated]
+  scenarios — one scripted-attack run through the real CLI, timeline
+           assertions enforced via its exit status       [gated on jax]
+  advsearch — the coverage-guided adversary-search smoke (fixed tiny
+           budget, fixed seed, CPU backend): one-compiled-program-per-
+           generation witnessed on its own trace + findings schema
+           (`make advsearch-smoke`)                      [gated on jax]
   tests  — the tier-1 pytest suite (JAX_PLATFORMS=cpu, -m 'not slow')
 
 "Gated" layers SKIP with a loud notice when their tool is not
@@ -132,6 +138,20 @@ def layer_scenarios(_: argparse.Namespace) -> str:
         else "ok"
 
 
+def layer_advsearch(_: argparse.Namespace) -> str:
+    # `python -m tools.advsearch smoke`: a fixed tiny-budget coverage-
+    # guided search (SMOKE constants in tools/advsearch/__main__.py)
+    # that self-checks the one-compiled-program-per-generation contract
+    # on its own trace (dispatch spans == generations) and the findings
+    # schema — exits nonzero on any violation. CPU backend, seconds.
+    import importlib.util
+    if importlib.util.find_spec("jax") is None:
+        return "SKIP (jax not installed)"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return "FAIL" if _run([sys.executable, "-m", "tools.advsearch",
+                           "smoke"], env=env) else "ok"
+
+
 def layer_tests(args: argparse.Namespace) -> str:
     if args.skip_tests:
         return "SKIP (--skip-tests)"
@@ -142,7 +162,8 @@ def layer_tests(args: argparse.Namespace) -> str:
 LAYERS = {"lint": layer_lint, "hlo": layer_hlo,
           "costcheck": layer_costcheck, "ruff": layer_ruff,
           "mypy": layer_mypy, "tidy": layer_tidy,
-          "scenarios": layer_scenarios, "tests": layer_tests}
+          "scenarios": layer_scenarios, "advsearch": layer_advsearch,
+          "tests": layer_tests}
 
 
 def main(argv=None) -> int:

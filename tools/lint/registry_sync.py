@@ -139,6 +139,7 @@ def _latency_violations(repo: Repo) -> list[Violation]:
 FIELD_REGISTRIES = (
     ("tools/costmodel/model.py", "CARD_FIELDS", "COST_CARD_FIELDS"),
     ("tools/ledger.py", "ROW_FIELDS", "LEDGER_ROW_FIELDS"),
+    ("tools/advsearch/search.py", "FINDING_FIELDS", "FINDING_FIELDS"),
 )
 
 

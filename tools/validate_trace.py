@@ -110,6 +110,22 @@ CHECKPOINT_IO_FIELDS = frozenset({
 _CHECKPOINT_IO_INTS = frozenset({"saves", "loads", "bytes_written",
                                  "bytes_read"})
 
+# One adversary-search finding = exactly these keys (tools/advsearch/
+# search.py FINDING_FIELDS — lint-synced both ways like the telemetry
+# counters): the coverage-guided search's counterexample record,
+# written by `python -m tools.advsearch search --findings-out` and
+# embedded per entry in the discovered-scenario catalog
+# (consensus_tpu/scenarios/discovered.json).
+FINDING_FIELDS = frozenset({
+    "schema", "space", "protocol", "generation", "candidate",
+    "eval_seed", "knobs", "budget", "severity", "fitness", "metrics",
+    "coverage_key", "oracle",
+})
+_FINDING_METRIC_KEYS = frozenset({
+    "availability", "stall_windows", "stall_ratio", "fault_onset_window",
+    "recovery_rounds", "never_recovered", "commit_rate", "lib_ratio",
+})
+
 # Cost-card top-level keys (tools/costmodel/model.py CARD_FIELDS —
 # lint-synced both ways like the telemetry counters): the Observatory's
 # per-config compiled cost summary, committed under
@@ -537,6 +553,72 @@ def validate_cli_report(path) -> list:
     return errs
 
 
+def validate_finding_doc(path, doc) -> list:
+    """Schema checks for an already-loaded findings artifact (the
+    `--finding` file, or the `finding` block of a discovered-scenario
+    catalog entry wraps one element of its ``findings`` list)."""
+    if not isinstance(doc, dict):
+        return [f"{path}: top level must be an object"]
+    errs = []
+    if doc.get("version") != 1:
+        errs.append(f"{path}: version {doc.get('version')!r} != 1")
+    for key in ("space", "search_seed", "generations"):
+        if key not in doc:
+            errs.append(f"{path}: missing key {key!r}")
+    findings = doc.get("findings")
+    if not isinstance(findings, list):
+        return errs + [f"{path}: 'findings' must be a list"]
+    for i, f in enumerate(findings):
+        if not isinstance(f, dict):
+            errs.append(f"{path}: findings[{i}] must be an object")
+            continue
+        for key in sorted(FINDING_FIELDS - set(f)):
+            errs.append(f"{path}: findings[{i}] missing key {key!r}")
+        for key in sorted(set(f) - FINDING_FIELDS):
+            errs.append(f"{path}: findings[{i}] key {key!r} is not in "
+                        "the known-field registry (advsearch and "
+                        "validator drifted?)")
+        knobs = f.get("knobs")
+        if not isinstance(knobs, dict) or not knobs or not all(
+                isinstance(k, str) and _num(v) and 0.0 <= v <= 1.0
+                for k, v in knobs.items()):
+            errs.append(f"{path}: findings[{i}].knobs must be a "
+                        "non-empty str -> rate-in-[0,1] object")
+        for key in ("budget", "severity"):
+            v = f.get(key)
+            if key in f and (not _num(v) or v < 0):
+                errs.append(f"{path}: findings[{i}].{key} must be a "
+                            "finite number >= 0")
+        m = f.get("metrics")
+        if not isinstance(m, dict):
+            errs.append(f"{path}: findings[{i}].metrics must be an "
+                        "object")
+        else:
+            for key in sorted(set(m) - _FINDING_METRIC_KEYS):
+                errs.append(f"{path}: findings[{i}].metrics key "
+                            f"{key!r} is not a known fitness signal")
+            av = m.get("availability")
+            if not _num(av) or not 0.0 <= av <= 1.0:
+                errs.append(f"{path}: findings[{i}].metrics."
+                            "availability must be in [0, 1]")
+        orc = f.get("oracle")
+        if not isinstance(orc, dict) or "confirmed" not in orc \
+                or not isinstance(orc["confirmed"], (bool, type(None))):
+            errs.append(f"{path}: findings[{i}].oracle must be an "
+                        "object with confirmed: bool|null")
+    return errs
+
+
+def validate_finding(path) -> list:
+    """Schema checks for a findings artifact file
+    (`python -m tools.advsearch search --findings-out`)."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable/not JSON: {exc}"]
+    return validate_finding_doc(path, doc)
+
+
 def validate_costcard(path) -> list:
     """Schema checks for one committed cost card
     (docs/OBSERVABILITY.md §"Observatory"): exactly the registered
@@ -665,6 +747,11 @@ def main(argv=None) -> int:
                          "stdout); telemetry counter names and "
                          "checkpoint_io fields are checked against the "
                          "known-name registries")
+    ap.add_argument("--finding", default="",
+                    help="an adversary-search findings artifact "
+                         "(tools/advsearch --findings-out); finding "
+                         "fields are checked against the known-field "
+                         "registry")
     ap.add_argument("--costcard", action="append", default=[],
                     help="a committed cost card "
                          "(benchmarks/parts/costcards/*.json; "
@@ -683,9 +770,9 @@ def main(argv=None) -> int:
                          "supervised-retry trace)")
     args = ap.parse_args(argv)
     if not (args.trace or args.metrics or args.report or args.cli_report
-            or args.costcard or args.ledger):
+            or args.costcard or args.ledger or args.finding):
         ap.error("nothing to validate: pass --trace/--metrics/--report/"
-                 "--cli-report/--costcard/--ledger")
+                 "--cli-report/--costcard/--ledger/--finding")
     if (args.expect_spans or args.expect_events) and not args.trace:
         ap.error("--expect-spans/--expect-events need --trace (they assert "
                  "presence in that file)")
@@ -712,6 +799,8 @@ def main(argv=None) -> int:
         errs += validate_costcard(card)
     if args.ledger:
         errs += validate_ledger(args.ledger)
+    if args.finding:
+        errs += validate_finding(args.finding)
     for e in errs:
         print(f"validate_trace: {e}", file=sys.stderr)
     if errs:
