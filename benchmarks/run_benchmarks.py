@@ -66,6 +66,17 @@ CONFIGS = {
     "pbft-100k-bcast": Config(protocol="pbft", fault_model="bcast",
                               f=33_333, n_nodes=100_000, n_rounds=64,
                               n_sweeps=8, log_capacity=16, seed=7, **ADV),
+    # 3c. The linear-communication BFT flagship (ROADMAP "HotStuff-class
+    # past the PBFT ceiling"): same population/tolerance as
+    # pbft-100k-bcast (N = 3f+1 = 100k), but every phase is a threshold
+    # count at the round leader — O(N) star delivery, zero sorts, an
+    # O(N + S) carry (SPEC §7b). log_capacity 64 so the chained
+    # pipeline commits one block per round for the WHOLE run (the §6b
+    # pbft shape saturates its 16 slots; hotstuff has no [N, S] carry
+    # to bound, so the flagship measures steady-state pipelining).
+    "hotstuff-100k": Config(protocol="hotstuff", f=33_333,
+                            n_nodes=100_000, n_rounds=64, n_sweeps=8,
+                            log_capacity=64, seed=8, **ADV),
     # 4. Multi-decree Paxos 10k acceptors x 10k slots.
     "paxos-10kx10k": Config(protocol="paxos", n_nodes=10_000, n_rounds=16,
                             n_sweeps=1, log_capacity=10_000, seed=4, **ADV),
@@ -92,7 +103,7 @@ ORACLE_SIZED: dict[str, Config] = {}
 # measure once instead of best-of-2 (single-core C++ has no warmup
 # effect worth a second multi-minute run).
 ORACLE_ONE_REPEAT = {"raft-100k", "pbft-100k-bcast", "paxos-10kx10k",
-                     "dpos-100k", "raft-1kx1k"}
+                     "dpos-100k", "raft-1kx1k", "hotstuff-100k"}
 
 # Dispatch-bound configs: the whole 5-node run is sub-millisecond of
 # device time, so back-to-back separate dispatches time the tunnel's

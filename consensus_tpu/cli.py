@@ -84,7 +84,7 @@ def build_parser() -> argparse.ArgumentParser:
         typ = _FLAG_TYPES.get(flag, int)
         kw = dict(type=typ, default=argparse.SUPPRESS)
         if flag == "protocol":
-            kw["choices"] = ["raft", "pbft", "paxos", "dpos"]
+            kw["choices"] = ["raft", "pbft", "paxos", "dpos", "hotstuff"]
         if flag == "engine":
             kw["choices"] = ["cpu", "tpu"]
         ap.add_argument("--" + flag.replace("_", "-"), **kw)
@@ -306,7 +306,7 @@ def args_to_config(args):
         fields["mesh_shape"] = tuple(fields["mesh_shape"])
     if fields.get("n_nodes") is None:
         fields["n_nodes"] = 3 * fields["f"] + 1 \
-            if fields["protocol"] == "pbft" else 5
+            if fields["protocol"] in ("pbft", "hotstuff") else 5
     return Config(**fields)
 
 
@@ -377,10 +377,11 @@ def main(argv=None) -> int:
             parser.error("--oracle-delivery is a cpu-oracle execution knob "
                          "(cpp/oracle.cpp Net); the tpu engine has no [N,N] "
                          "materialization to switch")
-        if cfg.protocol == "dpos":
-            parser.error("--oracle-delivery does not apply to dpos (its "
-                         "oracle queries one producer row per round — "
-                         "already edge-wise)")
+        if cfg.protocol in ("dpos", "hotstuff"):
+            parser.error(f"--oracle-delivery does not apply to "
+                         f"{cfg.protocol} (its oracle queries one "
+                         "leader/producer row per round — already "
+                         "edge-wise)")
 
     # Usage errors must fail fast — before any accelerator probe.
     if args.checkpoint and cfg.sweep_chunk and cfg.sweep_chunk < cfg.n_sweeps:

@@ -15,7 +15,7 @@ from typing import Any
 
 from .rng import prob_threshold_u32
 
-PROTOCOLS = ("raft", "pbft", "paxos", "dpos")
+PROTOCOLS = ("raft", "pbft", "paxos", "dpos", "hotstuff")
 ENGINES = ("cpu", "tpu")
 
 
@@ -131,19 +131,29 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             raise ValueError(f"unknown engine {self.engine!r}")
         if min(self.n_nodes, self.n_rounds, self.n_sweeps, self.log_capacity) < 1:
             raise ValueError("n_nodes, n_rounds, n_sweeps, log_capacity must be >= 1")
-        if self.protocol == "pbft":
+        if self.protocol in ("pbft", "hotstuff"):
             expect = 3 * self.f + 1
             if self.n_nodes != expect:
                 raise ValueError(
-                    f"pbft requires n_nodes == 3f+1 == {expect}, got {self.n_nodes}")
+                    f"{self.protocol} requires n_nodes == 3f+1 == "
+                    f"{expect}, got {self.n_nodes}")
             if self.n_byzantine > self.f:
                 raise ValueError("n_byzantine must be <= f")
         if self.n_byzantine < 0 or self.n_byzantine > self.n_nodes:
             raise ValueError("n_byzantine must be in [0, n_nodes]")
-        if self.n_byzantine > 0 and self.protocol not in ("pbft", "raft"):
+        if self.n_byzantine > 0 and self.protocol not in ("pbft", "raft",
+                                                          "hotstuff"):
             raise ValueError(
-                f"n_byzantine is a pbft/raft adversary (SPEC §6/§3c); "
-                f"{self.protocol} would silently ignore it")
+                f"n_byzantine is a pbft/raft/hotstuff adversary "
+                f"(SPEC §6/§3c/§7b); {self.protocol} would silently "
+                "ignore it")
+        if self.protocol == "hotstuff" and self.byz_mode != "silent":
+            raise ValueError(
+                "hotstuff models only the silent byzantine minority "
+                "(SPEC §7b: votes are threshold counts at the leader — "
+                "an equivocation stance has no per-value tally to "
+                "poison); byz_mode='equivocate' would silently behave "
+                "as 'silent'")
         if self.byz_mode not in ("silent", "equivocate"):
             raise ValueError(f"unknown byz_mode {self.byz_mode!r}")
         if self.fault_model not in ("edge", "bcast"):

@@ -15,6 +15,7 @@ Record meaning per protocol (a, b):
     pbft : (slot index, decided value)                — decided slots, ascending
     paxos: (slot index, learned value)                — learned slots, ascending
     dpos : (round index, producer id of chain block)  — in chain order
+    hotstuff: (height, decided value)                 — committed prefix, ascending
 
 The C++ oracle (cpp/oracle.cpp) emits the identical layout; equality is
 checked on raw bytes and reported as a SHA-256 digest (O(1) to compare,
@@ -29,7 +30,8 @@ import numpy as np
 
 MAGIC = b"CTPU"
 VERSION = 1
-PROTOCOL_IDS = {"raft": 0, "pbft": 1, "paxos": 2, "dpos": 3}
+PROTOCOL_IDS = {"raft": 0, "pbft": 1, "paxos": 2, "dpos": 3,
+                "hotstuff": 4}
 
 
 def serialize_decided(protocol: str, counts: np.ndarray,

@@ -1,0 +1,336 @@
+"""Chained HotStuff as a JAX array kernel (docs/SPEC.md §7b) — the
+linear-communication BFT engine.
+
+Classic PBFT's scalability wall is the O(N²) all-to-all vote exchange
+(PAPERS.md 2007.12637): even after the PR 8 sort diet, the §6b bcast
+round's bytes are sort passes over [S, N] temporaries — 9.67M steps/s
+at 100k nodes, 0.6% of the bandwidth floor (docs/PERF.md). The
+HotStuff lineage replaces the quadratic exchange with O(N)
+vote→leader→broadcast phases: every phase is a threshold *count* at
+the round leader. This engine is the array form of that move:
+
+  * **Star-shaped delivery.** One leader per view: the proposal is a
+    leader→node broadcast row (the dpos producer-row idiom — O(N)
+    per-receiver draws on absolute SPEC §2 edge keys) and the votes are
+    node→leader rows (O(N) per-sender draws). No [N, N] matrix, no
+    per-receiver multiset, ever.
+  * **Threshold counts, not tallies.** A quorum certificate (QC) forms
+    iff the delivered-vote count reaches Q = 2f+1 — ONE masked sum
+    reduction. Zero `lax.sort`, zero cumsum: the engine lands behind a
+    dpos-class ``PROGRAM_CONTRACT`` of sort_budget 0 / cumsum_budget 0.
+  * **Chained three-phase pipeline.** The QC chain registers (b1, b2,
+    b3) riding the carry ARE the prepare / pre-commit / commit phases
+    of three consecutive blocks: a new QC shifts the chain, and a
+    block commits when the three newest QCs sit in consecutive views
+    (the chained-HotStuff 3-chain rule). Fault-free steady state:
+    every round forms a QC, so every round commits one block while the
+    two newer blocks advance a phase — one block per round through a
+    three-deep pipeline.
+  * **Pacemaker.** Views rotate leaders round-robin (leader(v) =
+    v mod N). The view advances on QC formation, or — view-change —
+    after ``view_timeout`` rounds without one (a crashed / churned /
+    partitioned-away / silent-byzantine leader). A failed view breaks
+    the consecutive-view chain, so its cost is visible as
+    chain-commit lag, exactly the liveness shape the literature's
+    leader-rotation attacks target.
+
+State split: the pacemaker + QC-chain registers and the certified-view
+map are GLOBAL per sweep (the certified chain is the network's shared
+state; forks are unreachable in this model because a QC certifies one
+block per height and the next proposal extends the newest QC). The
+per-NODE state is what each replica has locally observed: its synced
+view, its progress timer, and its durable committed prefix — O(N)
+carry leaves, no [N, S] tensor anywhere.
+
+Scalar twin: ``cpp/oracle.cpp`` ``HotstuffSim`` (the PR 5
+aggregate-round pattern), byte-differential on decided logs across the
+full adversary surface (drop / partition / churn / §6c crash-recover /
+§A.2 delay) — tests/test_hotstuff.py.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core.config import Config
+from ..ops.adversary import (CRASH_TELEMETRY, bitcast_i32, crash_counts,
+                             crash_transition, delayed_open, freeze_down)
+from ..ops.adversary import cutoff as _lt
+from ..ops.adversary import draw as _draw
+from ..ops.flight import bucket_counts
+
+
+class HotstuffState(NamedTuple):
+    seed: jnp.ndarray     # [] uint32
+    gview: jnp.ndarray    # [] i32 — pacemaker view (global per sweep)
+    gtimer: jnp.ndarray   # [] i32 — rounds spent in the current view
+    b1_v: jnp.ndarray     # [] i32 — newest QC: view (-1 = none)
+    b1_h: jnp.ndarray     # [] i32 — newest QC: height (-1 = none)
+    b2_v: jnp.ndarray     # [] i32 — parent QC (the locked block)
+    b2_h: jnp.ndarray     # [] i32
+    b3_v: jnp.ndarray     # [] i32 — grandparent QC
+    b3_h: jnp.ndarray     # [] i32
+    gcommit: jnp.ndarray  # [] i32 — globally committed chain length
+    chain_v: jnp.ndarray  # [S] i32 — view that certified height s (-1)
+    view: jnp.ndarray     # [N] i32 — last view node i synced to
+    timer: jnp.ndarray    # [N] i32 — rounds since node i saw progress
+    clen: jnp.ndarray     # [N] i32 — committed length node i learned
+    down: jnp.ndarray     # [N] bool — SPEC §6c crashed mask
+
+
+# Compiled-program contract (tools/hlocheck): the linear-BFT claim,
+# machine-pinned — every phase is a count, so the ROUND program carries
+# ZERO sort-class and ZERO cumsum-class ops (dpos-class budgets; the
+# §6c max_crashed cap's admission cumsum is outside every registered
+# config, exactly as for dpos). node_sharded="bounded": the per-node
+# leaves are [N] vectors, the vote count is one psum, and the leader-
+# row gathers move O(N) metadata — never an [N, S] carry leaf (none
+# exists).
+PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=0,
+                        node_sharded="bounded")
+
+# SPEC §6c persistent/volatile carry split (tools/lint check
+# `registry`): a replica's committed prefix (`clen`) is the durable
+# state HotStuff's safety argument rests on; pacemaker sync (`view`,
+# `timer`) is volatile — a recovering node rejoins at view 0 and
+# resyncs from the next delivered proposal. The global pacemaker / QC
+# chain / certified-view map are the NETWORK's abstract state (like the
+# dpos producer schedule), not any node's — "meta", untouched by
+# crashes.
+CRASH_SPLIT = {
+    "seed": "meta",
+    "gview": "meta",
+    "gtimer": "meta",
+    "b1_v": "meta",
+    "b1_h": "meta",
+    "b2_v": "meta",
+    "b2_h": "meta",
+    "b3_v": "meta",
+    "b3_h": "meta",
+    "gcommit": "meta",
+    "chain_v": "meta",
+    "view": "volatile",
+    "timer": "volatile",
+    "clen": "persistent",
+    "down": "meta",
+}
+
+# On-device protocol telemetry (docs/OBSERVABILITY.md).
+HOTSTUFF_TELEMETRY = ("qc_formed",            # rounds forming a QC (0/1)
+                      "blocks_committed",     # global commit advance
+                      "commits_learned",      # Σ per-node clen advance
+                      "view_changes",         # timeout-driven advances
+                      "proposals_delivered",  # Σ receivers of the round
+                      "votes_counted",        # votes the leader counted
+                      ) + CRASH_TELEMETRY     # SPEC §6c (zeros when off)
+
+# Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
+# recorder"):
+#   view_change_wait_rounds — at each view advance (QC or timeout), the
+#     rounds the view took (gtimer + 1): 1 in the fault-free steady
+#     state, view_timeout under a dead leader.
+#   chain_commit_lag_rounds — per round, the pipeline depth
+#     head_height - gcommit: the chained prepare/pre-commit stages not
+#     yet committed (2-3 steady state; grows when failed views break
+#     the consecutive-view chain — the chained-commit-stall signal).
+HOTSTUFF_LATENCY = ("view_change_wait_rounds", "chain_commit_lag_rounds")
+
+
+def _block_val(seed, chain_v, slots):
+    """Block value at (certifying view, height) — SPEC §7b:
+    bitcast_i32(draw(STREAM_VALUE, view, 5, height)); pure counter
+    function, so decided values need no [N, S] state anywhere (the
+    oracle recomputes the identical u32). Broadcasts over inputs."""
+    return bitcast_i32(_draw(seed, rng.STREAM_VALUE,
+                             jnp.asarray(chain_v).astype(jnp.uint32), 5,
+                             jnp.asarray(slots).astype(jnp.uint32)))
+
+
+def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
+                   telem: bool = False, flight: bool = False):
+    N, S = cfg.n_nodes, cfg.log_capacity
+    Q = 2 * cfg.f + 1
+    seed = st.seed
+    ur = jnp.asarray(r, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    uidx = idx.astype(jnp.uint32)
+
+    # ---- SPEC §6c crash-recover prologue: advance the down mask,
+    # volatile reset on recovery (view/timer rejoin at 0; the committed
+    # prefix persists — the §7b durable state).
+    crash_on = cfg.crash_on
+    down = st.down
+    view, timer, clen = st.view, st.timer, st.clen
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,
+            cfg.max_crashed)
+        view = jnp.where(rec, 0, view)
+        timer = jnp.where(rec, 0, timer)
+        frozen = (view, timer, clen)
+
+    # ---- P0 churn: the round's leader is offline (SPEC §2 "all
+    # leaders step down" — in a one-leader-per-view protocol, the view's
+    # leader skips its slot, forcing the pacemaker's timeout path).
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+
+    # ---- P1 proposal: leader(gview) extends the newest QC with the
+    # block at height b1_h + 1; the broadcast is ONE leader→node
+    # delivery row on absolute §2 edge keys (the dpos producer-row
+    # idiom — O(N), never [N, N]).
+    L = st.gview % jnp.int32(N)
+    uL = L.astype(jnp.uint32)
+    honest = idx < (N - cfg.n_byzantine)   # SPEC §3c-style silent byz
+    h_next = st.b1_h + 1
+    proposing = ~churn & (L < N - cfg.n_byzantine) & (h_next < S)
+    if crash_on:
+        proposing &= ~down[L]
+
+    open_p = ~(rng.delivery_u32_jnp(seed, ur, uL, uidx)
+               < _lt(cfg.drop_cutoff))
+    open_v = ~(rng.delivery_u32_jnp(seed, ur, uidx, uL)
+               < _lt(cfg.drop_cutoff))
+    if cfg.max_delay_rounds > 0:
+        # SPEC §A.2 delayed retransmission, on the same absolute keys.
+        open_p |= delayed_open(seed, ur, uL, uidx, cfg.drop_cutoff,
+                               cfg.max_delay_rounds)
+        open_v |= delayed_open(seed, ur, uidx, uL, cfg.drop_cutoff,
+                               cfg.max_delay_rounds)
+    part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
+                   < _lt(cfg.partition_cutoff))
+    side = _draw(seed, rng.STREAM_PARTITION, ur, 1, uidx) & jnp.uint32(1)
+    side_L = _draw(seed, rng.STREAM_PARTITION, ur, 1, uL) & jnp.uint32(1)
+    same_side = (side == side_L) | ~part_active
+
+    pdel = proposing & ((idx == L) | (open_p & same_side))
+    if crash_on:
+        pdel &= ~down   # down receivers hear nothing (SPEC §6c)
+
+    # ---- P2 votes: receivers of the proposal vote; the vote is a
+    # node→leader flight on edge (j, L). Byzantine replicas (silent)
+    # withhold. The leader's threshold check is ONE count — the whole
+    # linear-communication point. (Given pdel, the partition side check
+    # on the return edge is the identical predicate — a same-side pair
+    # stays same-side within the round.)
+    vote = pdel & honest
+    vdel = vote & ((idx == L) | open_v)
+    cnt = jnp.sum(vdel.astype(jnp.int32))
+    qc = proposing & (cnt >= Q)
+
+    # ---- P3 QC-chain shift + chained 3-chain commit: the new QC is
+    # the prepare phase of its block, promotes its parent to
+    # pre-commit (the lock) and — when the three newest QCs sit in
+    # consecutive views — commits the grandparent.
+    b1_v = jnp.where(qc, st.gview, st.b1_v)
+    b1_h = jnp.where(qc, h_next, st.b1_h)
+    b2_v = jnp.where(qc, st.b1_v, st.b2_v)
+    b2_h = jnp.where(qc, st.b1_h, st.b2_h)
+    b3_v = jnp.where(qc, st.b2_v, st.b3_v)
+    b3_h = jnp.where(qc, st.b2_h, st.b3_h)
+    sarange = jnp.arange(S, dtype=jnp.int32)
+    chain_v = jnp.where((sarange == h_next) & qc, st.gview, st.chain_v)
+    consec = (b3_v >= 0) & (b1_v == b2_v + 1) & (b2_v == b3_v + 1)
+    gcommit = jnp.where(qc & consec,
+                        jnp.maximum(st.gcommit, b3_h + 1), st.gcommit)
+
+    # ---- P4 learning: the proposal carries the pacemaker view and the
+    # commit state as of proposal time, so every receiver syncs its
+    # view, resets its progress timer, and extends its durable
+    # committed prefix to the start-of-round global commit.
+    view = jnp.where(pdel, st.gview, view)
+    clen = jnp.where(pdel, jnp.maximum(clen, st.gcommit), clen)
+    timer = jnp.where(pdel, 0, timer + 1)
+
+    # ---- P5 pacemaker: QC advances the view; otherwise the view
+    # changes after view_timeout rounds without one.
+    to = ~qc & (st.gtimer + 1 >= cfg.view_timeout)
+    adv = qc | to
+    gview = st.gview + adv.astype(jnp.int32)
+    gtimer = jnp.where(adv, 0, st.gtimer + 1)
+
+    if crash_on:
+        # SPEC §6c freeze: a down node's local state holds its
+        # post-volatile-reset value (its timer must not tick, its
+        # prefix must not grow, while crashed).
+        view, timer, clen = freeze_down(down, frozen, (view, timer, clen))
+
+    new = HotstuffState(seed, gview, gtimer, b1_v, b1_h, b2_v, b2_h,
+                        b3_v, b3_h, gcommit, chain_v, view, timer, clen,
+                        down)
+    if not telem:
+        return new
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    vec = jnp.stack([qc.astype(jnp.int32),
+                     gcommit - st.gcommit,
+                     jnp.sum(new.clen - st.clen),
+                     to.astype(jnp.int32),
+                     jnp.sum(pdel.astype(jnp.int32)),
+                     cnt, *cz])
+    if not flight:
+        return new, vec
+    lat = jnp.stack([
+        bucket_counts(st.gtimer + 1, adv),
+        bucket_counts(b1_h + 1 - gcommit, True)])
+    return new, vec, lat
+
+
+def hotstuff_init(cfg: Config, seed) -> HotstuffState:
+    N, S = cfg.n_nodes, cfg.log_capacity
+    z = jnp.int32(0)
+    none = jnp.int32(-1)
+    return HotstuffState(
+        jnp.asarray(seed, jnp.uint32), z, z, none, none, none, none,
+        none, none, z, jnp.full((S,), -1, jnp.int32),
+        jnp.zeros(N, jnp.int32), jnp.zeros(N, jnp.int32),
+        jnp.zeros(N, jnp.int32), jnp.zeros(N, bool))
+
+
+def hotstuff_round_telem(cfg: Config, st: HotstuffState, r):
+    return hotstuff_round(cfg, st, r, telem=True)
+
+
+def hotstuff_round_flight(cfg: Config, st: HotstuffState, r):
+    return hotstuff_round(cfg, st, r, telem=True, flight=True)
+
+
+def _extract(st: HotstuffState) -> dict:
+    """Decided logs materialized from the O(N + S) carry: node i has
+    committed exactly heights [0, clen[i]); the value at height s is
+    the pure counter function of (certifying view, s) — so the [N, S]
+    tensors exist only here, in the one-time extraction epilogue,
+    never in the round program."""
+    S = st.chain_v.shape[-1]
+    sarange = jnp.arange(S, dtype=jnp.int32)
+    committed = sarange[None, None, :] < st.clen[..., None]
+    vals = _block_val(st.seed[..., None], st.chain_v, sarange[None, :])
+    dval = jnp.where(committed, vals[:, None, :], 0)
+    return {"committed": committed, "dval": dval,
+            "clen": st.clen, "gcommit": st.gcommit,
+            "chain_v": st.chain_v, "view": st.view}
+
+
+def _pspec(cfg: Config) -> HotstuffState:
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import NODE_AXIS as ND
+    g, v = P(), P(ND)
+    return HotstuffState(seed=g, gview=g, gtimer=g, b1_v=g, b1_h=g,
+                         b2_v=g, b2_h=g, b3_v=g, b3_h=g, gcommit=g,
+                         chain_v=P(None), view=v, timer=v, clen=v, down=v)
+
+
+_ENGINE = None
+
+
+def get_engine():
+    global _ENGINE
+    if _ENGINE is None:
+        from ..network.runner import EngineDef
+        _ENGINE = EngineDef("hotstuff", hotstuff_init, hotstuff_round,
+                            _extract, _pspec,
+                            telemetry_names=HOTSTUFF_TELEMETRY,
+                            round_telem=hotstuff_round_telem,
+                            latency_names=HOTSTUFF_LATENCY,
+                            round_flight=hotstuff_round_flight)
+    return _ENGINE

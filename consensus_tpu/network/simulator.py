@@ -180,7 +180,7 @@ def decided_payload(cfg: Config, out: dict):
         counts, rec_a, rec_b = serialize.pack_sparse(
             np.asarray(out["learned_mask"]).astype(bool),
             np.asarray(out["learned_val"]))
-    elif cfg.protocol == "pbft":
+    elif cfg.protocol in ("pbft", "hotstuff"):
         counts, rec_a, rec_b = serialize.pack_sparse(
             np.asarray(out["committed"]).astype(bool),
             np.asarray(out["dval"]))
@@ -218,6 +218,9 @@ def engine_def(cfg: Config):
     if cfg.protocol == "dpos":
         from ..engines import dpos
         return dpos.get_engine()
+    if cfg.protocol == "hotstuff":
+        from ..engines import hotstuff
+        return hotstuff.get_engine()
     raise NotImplementedError(cfg.protocol)
 
 
@@ -232,16 +235,19 @@ def _run_jax(cfg: Config, **engine_kw):
 def _run_oracle(cfg: Config, delivery: str = "auto"):
     from ..oracle import bindings
     runners = {"raft": bindings.raft_run, "paxos": bindings.paxos_run,
-               "pbft": bindings.pbft_run, "dpos": bindings.dpos_run}
+               "pbft": bindings.pbft_run, "dpos": bindings.dpos_run,
+               "hotstuff": bindings.hotstuff_run}
     if cfg.protocol not in runners:
         raise NotImplementedError(cfg.protocol)
     fn = runners[cfg.protocol]
-    if cfg.protocol == "dpos":
-        # DPoS has no [N, N] delivery layer to switch (one producer row
-        # per round is already edge-wise) — reject rather than ignore.
+    if cfg.protocol in ("dpos", "hotstuff"):
+        # Neither has an [N, N] delivery layer to switch (one producer/
+        # leader row per round is already edge-wise) — reject rather
+        # than ignore.
         if delivery != "auto":
-            raise ValueError("oracle_delivery does not apply to dpos (its "
-                             "oracle queries one producer row per round)")
+            raise ValueError(
+                f"oracle_delivery does not apply to {cfg.protocol} (its "
+                "oracle queries one leader/producer row per round)")
         kw = {}
     else:
         kw = {"delivery": delivery}

@@ -45,6 +45,7 @@ COMMIT_COUNTERS = {
     "pbft-bcast": ("commit_quorums", "commits_adopted"),
     "paxos": ("values_learned",),
     "dpos": ("blocks_appended",),
+    "hotstuff": ("commits_learned",),
 }
 # Counters whose first nonzero window marks FAULT ONSET for the
 # recovery-time metric: the §6c crash adversary, the SPEC Appendix A

@@ -67,6 +67,8 @@ def get_lib() -> ctypes.CDLL:
     pi32 = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
     lib.ctpu_dpos_run.restype = ctypes.c_int
     lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 14 + [p32] * 3 + [pi32]
+    lib.ctpu_hotstuff_run.restype = ctypes.c_int
+    lib.ctpu_hotstuff_run.argtypes = [u64] + [u32] * 13 + [p8, p32, p32, p32]
     _lib = lib
     return lib
 
@@ -154,6 +156,31 @@ def pbft_run(cfg, sweep: int = 0, delivery: str = "auto"):
         out["committed"].reshape(-1), out["dval"].reshape(-1), out["view"])
     if rc != 0:
         raise RuntimeError(f"oracle pbft_run failed rc={rc}")
+    return out
+
+
+def hotstuff_run(cfg, sweep: int = 0):
+    """Run one chained-HotStuff sweep in the oracle (SPEC §7b). Returns
+    dict of final arrays. No ``delivery`` knob: the oracle queries only
+    the leader's O(N) star edges — already edge-wise, like dpos."""
+    lib = get_lib()
+    N, S = cfg.n_nodes, cfg.log_capacity
+    out = {
+        "committed": np.zeros((N, S), np.uint8),
+        "dval": np.zeros((N, S), np.uint32),
+        "clen": np.zeros(N, np.uint32),
+        "view": np.zeros(N, np.uint32),
+    }
+    seed = (cfg.seed + sweep) & 0xFFFFFFFFFFFFFFFF
+    rc = lib.ctpu_hotstuff_run(
+        seed, N, cfg.n_rounds, S, cfg.f, cfg.view_timeout, cfg.n_byzantine,
+        cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
+        cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
+        cfg.max_delay_rounds,
+        out["committed"].reshape(-1), out["dval"].reshape(-1),
+        out["clen"], out["view"])
+    if rc != 0:
+        raise RuntimeError(f"oracle hotstuff_run failed rc={rc}")
     return out
 
 

@@ -127,6 +127,28 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
         tuned=dict(n_nodes=7, n_rounds=96, log_capacity=32,
                    max_entries=24)),
     Scenario(
+        name="chained-commit-stall",
+        description="SPEC §7b chained HotStuff under the §A.2 delay "
+                    "stream + §6c leader outages: crashed/churned "
+                    "leaders force view-timeout changes, failed views "
+                    "break the consecutive-view 3-chain so commits "
+                    "stall while the QC pipeline re-fills, and heavy "
+                    "lossy-but-delayed delivery stutters quorum "
+                    "formation (the chained-commit-stall liveness "
+                    "shape the linear-BFT literature targets; "
+                    "PAPERS.md 2007.12637).",
+        protocol="hotstuff",
+        overrides=dict(drop_rate=0.35, max_delay_rounds=6,
+                       crash_prob=0.12, recover_prob=0.35,
+                       max_crashed=2, churn_rate=0.05,
+                       view_timeout=4),
+        bounds=TimelineBounds(max_availability=0.98,
+                              min_availability=0.25,
+                              min_stall_windows=1,
+                              max_recovery_rounds=96),
+        window=4,
+        tuned=dict(n_nodes=7, f=2, n_rounds=96, log_capacity=96)),
+    Scenario(
         name="crash-churn-under-partition",
         description="SPEC §6c crash/recover under intermittent "
                     "bipartitions and leader churn (PBFT): view changes "
@@ -222,7 +244,7 @@ def apply(cfg, scenario: Scenario, explicit=frozenset()):
                 f"requested {cfg.protocol!r}; drop --protocol or pass "
                 f"--protocol {scenario.protocol}")
         derived: dict[str, Any] = {}
-        if scenario.protocol == "pbft":
+        if scenario.protocol in ("pbft", "hotstuff"):
             derived["n_nodes"] = 3 * cfg.f + 1
         elif scenario.protocol == "dpos":
             cand = min(cfg.n_candidates, cfg.n_nodes)

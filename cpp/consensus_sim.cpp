@@ -85,7 +85,7 @@ uint32_t prob_threshold_u32(double p) {
 [[noreturn]] void usage(const char* argv0, int code) {
   std::fprintf(
       stderr,
-      "usage: %s [--protocol raft|pbft|paxos|dpos] [--engine cpu|tpu]\n"
+      "usage: %s [--protocol raft|pbft|paxos|dpos|hotstuff] [--engine cpu|tpu]\n"
       "  [--nodes N] [--rounds R] [--sweeps B] [--seed S]\n"
       "  [--log-capacity L] [--max-entries E] [--t-min T] [--t-max T]\n"
       "  [--max-active A]   (raft: 0 = dense, >0 = SPEC 3b active cap)\n"
@@ -150,7 +150,16 @@ Args parse(int argc, char** argv) {
     else if (k == "--help" || k == "-h") usage(argv[0], 0);
     else { std::fprintf(stderr, "unknown flag %s\n", k.c_str()); usage(argv[0], 2); }
   }
-  if (a.protocol == "pbft" && !a.nodes_given) a.nodes = 3 * a.f + 1;
+  if ((a.protocol == "pbft" || a.protocol == "hotstuff") && !a.nodes_given)
+    a.nodes = 3 * a.f + 1;
+  if (a.protocol == "hotstuff" && a.byz_mode == "equivocate") {
+    std::fprintf(stderr,
+                 "--byz-mode equivocate: hotstuff models only the silent "
+                 "byzantine minority (SPEC 7b: votes are threshold counts "
+                 "at the leader — no per-value tally to poison); the mode "
+                 "would silently behave as silent\n");
+    std::exit(2);
+  }
   if (a.byz_mode != "silent" && a.byz_mode != "equivocate") {
     std::fprintf(stderr, "unknown --byz-mode %s\n", a.byz_mode.c_str());
     std::exit(2);
@@ -199,11 +208,12 @@ Args parse(int argc, char** argv) {
                  "--max-delay-rounds must be in [0, 16] (SPEC A.2)\n");
     std::exit(2);
   }
-  if (a.oracle_delivery != "auto" && a.protocol == "dpos") {
+  if (a.oracle_delivery != "auto" &&
+      (a.protocol == "dpos" || a.protocol == "hotstuff")) {
     std::fprintf(stderr,
-                 "--oracle-delivery: dpos has no [N,N] delivery layer (one "
-                 "producer row per round is already edge-wise); the flag "
-                 "would be silently ignored\n");
+                 "--oracle-delivery: %s has no [N,N] delivery layer (one "
+                 "producer/leader row per round is already edge-wise); the "
+                 "flag would be silently ignored\n", a.protocol.c_str());
     std::exit(2);
   }
   return a;

@@ -1405,6 +1405,145 @@ struct DposSim {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Chained HotStuff (SPEC §7b). O(N) per round: one leader→node proposal
+// row, one node→leader vote row, one threshold count — the scalar twin
+// of engines/hotstuff.py (the PR 5 aggregate-round pattern: the oracle
+// implements the same linear-communication phases straight from the
+// SPEC definition, never via the engine's array formulation).
+// ---------------------------------------------------------------------------
+
+struct HotstuffSim {
+  uint64_t seed;
+  uint32_t N, R, S, f, view_timeout, n_byz;
+  uint32_t drop_cut, part_cut, churn_cut;
+  // SPEC §6c / §A.2 adversary knobs (0 = off).
+  uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
+  CrashAdv crash;
+
+  // Global pacemaker + QC-chain state (the network's shared state —
+  // forks are unreachable: a QC certifies one block per height and the
+  // next proposal extends the newest QC).
+  uint32_t gview = 0, gtimer = 0, gcommit = 0;
+  int32_t b1_v = -1, b1_h = -1, b2_v = -1, b2_h = -1, b3_v = -1, b3_h = -1;
+  std::vector<int32_t> chain_view;  // [S]; -1 = height never certified
+  // Per-node state: pacemaker sync (volatile) + committed prefix
+  // (persistent, SPEC §6c).
+  std::vector<uint32_t> view_, timer, clen;     // [N]
+  std::vector<uint8_t> committed;               // [N*S], filled at end
+  std::vector<uint32_t> dval;                   // [N*S], filled at end
+
+  bool honest(uint32_t i) const { return i < N - n_byz; }
+
+  void run() {
+    gview = gtimer = gcommit = 0;
+    b1_v = b1_h = b2_v = b2_h = b3_v = b3_h = -1;
+    chain_view.assign(S, -1);
+    view_.assign(N, 0);
+    timer.assign(N, 0);
+    clen.assign(N, 0);
+    crash.init(N, crash_cut);
+    for (uint32_t r = 0; r < R; ++r) round(r);
+    committed.assign(size_t(N) * S, 0);
+    dval.assign(size_t(N) * S, 0);
+    for (uint32_t n = 0; n < N; ++n)
+      for (uint32_t s = 0; s < clen[n]; ++s) {
+        committed[size_t(n) * S + s] = 1;
+        // SPEC §7b block value: a pure counter function of
+        // (certifying view, height) — recomputed here exactly as the
+        // engine's extraction epilogue recomputes it.
+        dval[size_t(n) * S + s] = random_u32(
+            seed, STREAM_VALUE, uint32_t(chain_view[s]), 5, s);
+      }
+  }
+
+  void round(uint32_t r) {
+    const uint32_t Q = 2 * f + 1;
+    // SPEC §6c prologue: advance the down mask; volatile reset on
+    // recovery (view/timer rejoin at 0; the committed prefix is the
+    // persisted state HotStuff's safety argument rests on).
+    crash.advance(seed, r, crash_cut, recover_cut, max_crashed);
+    if (crash.on)
+      for (uint32_t i = 0; i < N; ++i)
+        if (crash.rec[i]) { view_[i] = 0; timer[i] = 0; }
+
+    // P0 churn: the view's leader skips its slot this round.
+    const bool churn = churn_fires(seed, r, churn_cut);
+
+    // P1 proposal: leader(gview) extends the newest QC at height
+    // b1_h + 1; silent-byzantine and down leaders withhold it.
+    const uint32_t L = gview % N;
+    const int32_t h_next = b1_h + 1;
+    const bool proposing = !churn && honest(L) && h_next < int32_t(S) &&
+                           !crash.is_down(L);
+    const bool part_active =
+        random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
+    const uint32_t side_L =
+        random_u32(seed, STREAM_PARTITION, r, 1, L) & 1u;
+    const uint32_t start_commit = gcommit;  // what the proposal carries
+
+    uint32_t votes = 0;
+    std::vector<uint8_t> pdel(N, 0);
+    if (proposing) {
+      for (uint32_t j = 0; j < N; ++j) {
+        if (crash.is_down(j)) continue;  // down receivers hear nothing
+        bool del = j == L;
+        if (!del) {
+          // SPEC §2 drop leg on the absolute edge key (r, L, j),
+          // repaired by a §A.2 delayed retransmission; partitions are
+          // topology faults — never repaired.
+          bool open = delivery_u32(seed, r, L, j) >= drop_cut;
+          if (!open && max_delay)
+            open = delayed_open(seed, r, L, j, drop_cut, max_delay);
+          del = open &&
+                (!part_active ||
+                 (random_u32(seed, STREAM_PARTITION, r, 1, j) & 1u) ==
+                     side_L);
+        }
+        if (!del) continue;
+        pdel[j] = 1;
+        // P2 vote: receivers vote; the vote is the return flight on
+        // edge (j, L). Given delivery of the proposal, a partition
+        // cannot separate the pair again within the round — only the
+        // drop leg applies to the return edge.
+        if (honest(j)) {
+          bool vd = j == L;
+          if (!vd) {
+            bool open = delivery_u32(seed, r, j, L) >= drop_cut;
+            if (!open && max_delay)
+              open = delayed_open(seed, r, j, L, drop_cut, max_delay);
+            vd = open;
+          }
+          if (vd) ++votes;
+        }
+        // P4 learning: the proposal carries the pacemaker view and the
+        // commit state as of proposal time.
+        view_[j] = gview;
+        timer[j] = 0;
+        clen[j] = std::max(clen[j], start_commit);
+      }
+    }
+    for (uint32_t j = 0; j < N; ++j)
+      if (!crash.is_down(j) && !pdel[j]) timer[j] += 1;
+
+    // P3 QC-chain shift + chained 3-chain commit (consecutive views).
+    const bool qc = proposing && votes >= Q;
+    if (qc) {
+      b3_v = b2_v; b3_h = b2_h;
+      b2_v = b1_v; b2_h = b1_h;
+      b1_v = int32_t(gview); b1_h = h_next;
+      chain_view[h_next] = int32_t(gview);
+      if (b3_v >= 0 && b1_v == b2_v + 1 && b2_v == b3_v + 1)
+        gcommit = std::max(gcommit, uint32_t(b3_h + 1));
+    }
+
+    // P5 pacemaker: QC advances the view; else timeout after
+    // view_timeout rounds without one.
+    const bool to = !qc && gtimer + 1 >= view_timeout;
+    if (qc || to) { gview += 1; gtimer = 0; } else { gtimer += 1; }
+  }
+};
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -1527,6 +1666,28 @@ class PaxosEngine final : public SlotEngine<PaxosSim> {
   const uint32_t* vals() const override { return sim_.learned_val.data(); }
 };
 
+class HotstuffEngine final : public SlotEngine<HotstuffSim> {
+ public:
+  const char* name() const override { return "hotstuff"; }
+  int run(const SimConfig& c) override {
+    if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f) return 1;
+    sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
+    sim_.S = c.log_capacity; sim_.f = c.f;
+    sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
+    sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
+    sim_.churn_cut = c.churn_cut;
+    sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
+    sim_.max_crashed = c.max_crashed; sim_.max_delay = c.max_delay;
+    sim_.run();
+    return 0;
+  }
+
+ protected:
+  uint32_t slots() const override { return sim_.S; }
+  const uint8_t* mask() const override { return sim_.committed.data(); }
+  const uint32_t* vals() const override { return sim_.dval.data(); }
+};
+
 class DposEngine final : public Engine {
  public:
   const char* name() const override { return "dpos"; }
@@ -1566,6 +1727,7 @@ std::unique_ptr<Engine> make_engine(const std::string& protocol) {
   if (protocol == "pbft") return std::make_unique<PbftEngine>();
   if (protocol == "paxos") return std::make_unique<PaxosEngine>();
   if (protocol == "dpos") return std::make_unique<DposEngine>();
+  if (protocol == "hotstuff") return std::make_unique<HotstuffEngine>();
   return nullptr;
 }
 
@@ -1574,6 +1736,7 @@ int protocol_id(const std::string& protocol) {
   if (protocol == "pbft") return 1;
   if (protocol == "paxos") return 2;
   if (protocol == "dpos") return 3;
+  if (protocol == "hotstuff") return 4;
   return -1;
 }
 
@@ -1718,6 +1881,34 @@ int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   std::memcpy(out_chain_p, sim.chain_p.data(), sizeof(uint32_t) * vl);
   std::memcpy(out_chain_len, sim.chain_len.data(), sizeof(uint32_t) * n_nodes);
   std::memcpy(out_lib, sim.lib.data(), sizeof(int32_t) * n_nodes);
+  return 0;
+}
+
+int ctpu_hotstuff_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
+                      uint32_t n_slots, uint32_t f, uint32_t view_timeout,
+                      uint32_t n_byzantine,  // SPEC §7b silent minority
+                      uint32_t drop_cut, uint32_t part_cut,
+                      uint32_t churn_cut,
+                      uint32_t crash_cut, uint32_t recover_cut,  // SPEC §6c
+                      uint32_t max_crashed,
+                      uint32_t max_delay,       // SPEC §A.2 (0 = off)
+                      uint8_t* out_committed,   // [N*S]
+                      uint32_t* out_dval,       // [N*S]
+                      uint32_t* out_clen,       // [N]
+                      uint32_t* out_view) {     // [N]
+  if (n_nodes != 3 * f + 1 || n_byzantine > f || max_delay > 16) return 1;
+  ctpu::HotstuffSim sim;
+  sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
+  sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
+  sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
+  sim.max_crashed = max_crashed; sim.max_delay = max_delay;
+  sim.run();
+  size_t ns = size_t(n_nodes) * n_slots;
+  std::memcpy(out_committed, sim.committed.data(), ns);
+  std::memcpy(out_dval, sim.dval.data(), sizeof(uint32_t) * ns);
+  std::memcpy(out_clen, sim.clen.data(), sizeof(uint32_t) * n_nodes);
+  std::memcpy(out_view, sim.view_.data(), sizeof(uint32_t) * n_nodes);
   return 0;
 }
 

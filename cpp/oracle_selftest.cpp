@@ -30,6 +30,10 @@ int ctpu_dpos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t*, uint32_t*,
                   uint32_t*, int32_t*);
+int ctpu_hotstuff_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                      uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                      uint32_t, uint32_t, uint32_t, uint32_t,
+                      uint8_t*, uint32_t*, uint32_t*, uint32_t*);
 }
 
 namespace {
@@ -181,6 +185,27 @@ int main() {
                            CRASH, REC, 2, 3,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
+    });
+  }
+  {
+    // SPEC §7b chained HotStuff: composed drop/partition/churn, a
+    // silent byzantine minority, and §6c crash + §A.2 delay.
+    const uint32_t f = 2, N = 3 * f + 1, R = 96, S = 64;
+    size_t ns = size_t(N) * S;
+    size_t W = (ns + 3) / 4 + ns + N + N;
+    rc |= run_twice("hotstuff", W, [&](uint32_t* o) {
+      return ctpu_hotstuff_run(33, N, R, S, f, 8, 1, DROP, PART, CHURN,
+                               0, 0, 0, 0,
+                               reinterpret_cast<uint8_t*>(o),
+                               o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
+                               o + (ns + 3) / 4 + ns + N);
+    });
+    rc |= run_twice("hotstuff-crash-delay", W, [&](uint32_t* o) {
+      return ctpu_hotstuff_run(33, N, R, S, f, 8, 0, DROP, PART, CHURN,
+                               CRASH, REC, 2, 4,
+                               reinterpret_cast<uint8_t*>(o),
+                               o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
+                               o + (ns + 3) / 4 + ns + N);
     });
   }
   {

@@ -102,6 +102,25 @@ def _sorty_round(cfg: Config, c: FakeCarry, r) -> FakeCarry:
     return FakeCarry(vals=s1 + jnp.uint32(1), log=s2 + 1)
 
 
+def sorty_hotstuff_engine() -> EngineDef:
+    """The REAL hotstuff round with a gratuitous sort + cumsum bolted
+    on — the regression a naive 'optimization' would introduce. Checked
+    against hotstuff's OWN declared contract (sort_budget 0 /
+    cumsum_budget 0), it proves the linear-BFT ceiling fires at zero:
+    even one sort-class or one cumsum-class op in the compiled round is
+    a violation (tests/test_hlocheck.py)."""
+    from consensus_tpu.engines import hotstuff
+
+    def bad_round(cfg: Config, st, r):
+        new = hotstuff.hotstuff_round(cfg, st, r)
+        return new._replace(view=jnp.sort(new.view),
+                            timer=jnp.cumsum(new.timer))
+
+    base = hotstuff.get_engine()
+    return EngineDef("fake-hotstuff-sorty", base.make_carry, bad_round,
+                     base.extract, base.carry_pspec)
+
+
 ok_engine = _engine(_ok_round, "fake-ok")
 f64_engine = _engine(_f64_round, "fake-f64")
 gather_engine = _engine(_gather_round, "fake-gather")

@@ -353,6 +353,9 @@ SCENARIO_SHAPES = {
     "crash-churn-under-partition": Config(
         protocol="pbft", f=2, n_nodes=7, n_rounds=96, log_capacity=16,
         n_sweeps=2, seed=11),
+    "chained-commit-stall": Config(
+        protocol="hotstuff", f=2, n_nodes=7, n_rounds=96,
+        log_capacity=96, n_sweeps=2, seed=11),
     # advsearch-discovered (tools/advsearch, scenarios/discovered.json):
     # the search's low-drop compound collapse — same tuned shape the
     # distiller verified at.
@@ -568,6 +571,31 @@ def test_python_cli_scenario_verdict(capsys):
     assert out["scenario"]["name"] == "delay-storm"
     assert out["scenario"]["passed"] is True
     assert out["telemetry"]["attack_rounds"] == 0
+
+
+def test_python_cli_hotstuff_smoke_verdict(capsys):
+    """The second `make check` scenario smoke (tools/check
+    .HOTSTUFF_SMOKE): the EXACT CI invocation of the chained-commit
+    stall runs at the scenario's tuned reference shape and passes its
+    bounds — same drift guard as test_python_cli_scenario_verdict."""
+    from consensus_tpu import cli
+    from consensus_tpu import scenarios
+    from tools.check import HOTSTUFF_SMOKE
+    argv = HOTSTUFF_SMOKE[HOTSTUFF_SMOKE.index("--scenario"):]
+    smoke_cfg = scenarios.apply(
+        cli.args_to_config(cli.build_parser().parse_args(argv)),
+        scenarios.get("chained-commit-stall"))
+    assert scenarios.off_tuned(scenarios.get("chained-commit-stall"),
+                               smoke_cfg) == {}
+    rc = cli.main(argv)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["scenario"]["name"] == "chained-commit-stall"
+    assert out["scenario"]["passed"] is True
+    # The stall shape is real: failed views observed (timeout-driven
+    # view changes) while commits still flow.
+    assert out["telemetry"]["view_changes"] > 0
+    assert out["telemetry"]["commits_learned"] > 0
 
 
 def test_python_cli_rejects_cpu_scenario():

@@ -207,8 +207,13 @@ def test_search_population_derivation_is_pure():
 # Every adversary-facing Config knob the search (or a user) may
 # compose, with generators spanning valid AND invalid values.
 _FUZZ_FIELDS = {
-    "protocol": lambda r: r.choice(["raft", "pbft", "paxos", "dpos"]),
+    "protocol": lambda r: r.choice(["raft", "pbft", "paxos", "dpos",
+                                    "hotstuff"]),
     "engine": lambda r: r.choice(["cpu", "tpu"]),
+    # The SPEC §7b engine's shape/pacemaker fields (shared with pbft):
+    # fuzzed so hotstuff's byz-mode/shape cross-rules are exercised too.
+    "f": lambda r: r.choice([1, 2]),
+    "view_timeout": lambda r: r.choice([2, 8]),
     "drop_rate": lambda r: r.choice([0.0, 0.3, 1.0]),
     "partition_rate": lambda r: r.choice([0.0, 0.25, 1.0]),
     "churn_rate": lambda r: r.choice([0.0, 0.1]),
@@ -238,7 +243,7 @@ def test_knob_fuzz_config_validates_or_raises_value_error():
     for _ in range(400):
         kw = {name: gen(rng) for name, gen in _FUZZ_FIELDS.items()
               if rng.random() < 0.6}
-        if kw.get("protocol") == "pbft":
+        if kw.get("protocol") in ("pbft", "hotstuff"):
             # Keep the shape constraint orthogonal to the knob fuzz
             # (n_nodes == 3f+1 is a shape rule, not an adversary knob).
             kw["n_nodes"] = 3 * kw.get("f", 1) + 1
@@ -255,8 +260,10 @@ def test_knob_fuzz_config_validates_or_raises_value_error():
         assert Config.from_json(cfg.to_json()) == cfg
     # The generators must actually exercise both outcomes (most random
     # compositions trip a cross-field rule — that asymmetry is the
-    # no-silent-ignores discipline doing its job).
-    assert built > 25 and rejected > 100, (built, rejected)
+    # no-silent-ignores discipline doing its job, and it widened with
+    # the hotstuff surface: two of five protocols are now BFT shapes
+    # that additionally reject equivocate/bcast/miss/attack combos).
+    assert built > 12 and rejected > 100, (built, rejected)
 
 
 def test_space_definitions_are_gate_representative():
@@ -313,6 +320,70 @@ def test_oracle_confirm_replays_byte_equal():
     atk = advsearch.SPACES["raft-attack-elect"]
     assert advsearch._confirm(atk, dict(attack_rate=0.5), seed=1) == \
         {"confirmed": None, "reason": "tpu-only"}
+
+
+def test_attack_report_routes_unmirrored_findings(tmp_path):
+    """§A.3 attack-space findings cannot be oracle-confirmed, so they
+    bypass the distilled catalog and land in the standalone
+    attack-findings report instead: distill refuses with a pointer at
+    the report path, write_attack_report round-trips the finding schema
+    and replaces entries keyed by (space, seed)."""
+    atk = advsearch.SPACES["raft-attack-elect"]
+    finding = {
+        "schema": 1, "space": atk.name, "protocol": "raft",
+        "generation": 0, "candidate": 0, "eval_seed": 1,
+        "knobs": {"attack_rate": 0.5, "drop_rate": 0.1}, "budget": 0.3,
+        "severity": 0.5, "fitness": 0.35,
+        "metrics": {"availability": 0.5, "stall_ratio": 0.2,
+                    "stall_windows": 2, "never_recovered": False,
+                    "recovery_rounds": 8},
+        "coverage_key": "a5s2n0l-",
+        "oracle": {"confirmed": None, "reason": "tpu-only"}}
+    st = advsearch.SearchState(space=atk.name, search_seed=7,
+                               population=4, generations_done=2,
+                               findings=[finding])
+    with pytest.raises(ValueError, match="report"):
+        advsearch.distill(st, 0, "x")
+    out = tmp_path / "attack_findings.json"
+    entry = advsearch.write_attack_report(st, out)
+    assert entry["mirrored"] is False
+    doc = json.loads(out.read_text())
+    assert len(doc["reports"]) == 1
+    assert doc["reports"][0]["findings"][0]["knobs"]["attack_rate"] == 0.5
+    # Same (space, seed) replaces; a different seed appends.
+    advsearch.write_attack_report(st, out)
+    assert len(json.loads(out.read_text())["reports"]) == 1
+    st2 = dataclasses.replace(st, search_seed=8)
+    advsearch.write_attack_report(st2, out)
+    assert len(json.loads(out.read_text())["reports"]) == 2
+    # The findings inside obey the validator's finding schema.
+    errs = validate_trace.validate_finding_doc("rep", {
+        "version": 1, "space": st.space, "search_seed": 7,
+        "generations": 2, "findings": st.findings})
+    assert errs == []
+
+
+def test_committed_attack_report_schema_valid():
+    """The committed §A.3 report artifact (benchmarks/parts/
+    attack_findings.json) holds only unmirrored-space findings with
+    explicit unconfirmed-oracle provenance, schema-checked."""
+    path = REPO / "benchmarks/parts/attack_findings.json"
+    assert path.exists(), "attack_findings.json missing"
+    doc = json.loads(path.read_text())
+    assert doc["version"] == advsearch.ATTACK_REPORT_VERSION
+    assert doc["reports"]
+    for rep in doc["reports"]:
+        assert rep["mirrored"] is False
+        assert rep["findings"], "a committed report must carry findings"
+        for f in rep["findings"]:
+            assert f["oracle"]["confirmed"] is None
+            assert f["oracle"]["reason"] == "tpu-only"
+        errs = validate_trace.validate_finding_doc("committed", {
+            "version": 1, "space": rep["space"],
+            "search_seed": rep["search_seed"],
+            "generations": rep["generations"],
+            "findings": rep["findings"]})
+        assert errs == []
 
 
 # --- 5. the committed discovered catalog ------------------------------------

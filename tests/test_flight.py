@@ -67,6 +67,8 @@ CFGS = {
     "pbft-bcast": Config(protocol="pbft", fault_model="bcast", f=5,
                          n_nodes=16, n_rounds=24, log_capacity=8,
                          telemetry_window=6, **ADV),
+    "hotstuff": Config(protocol="hotstuff", f=1, n_nodes=4, n_rounds=24,
+                       log_capacity=24, telemetry_window=6, **ADV),
 }
 
 
@@ -393,7 +395,8 @@ def test_progress_counters_agree_with_timeline_layer():
     # declaration); what needs pinning is that the declaration covers
     # every engine and only real telemetry counter names.
     assert set(timeline.COMMIT_COUNTERS) == \
-        {"raft", "raft-sparse", "pbft", "pbft-bcast", "paxos", "dpos"}
+        {"raft", "raft-sparse", "pbft", "pbft-bcast", "paxos", "dpos",
+         "hotstuff"}
     for name, names in timeline.COMMIT_COUNTERS.items():
         eng = simulator.engine_def(CFGS[name])
         assert set(names) <= set(eng.telemetry_names), name

@@ -187,6 +187,30 @@ def test_fsweep_target_contract_pinned():
     assert rep.sort_ops == 1
 
 
+def test_hotstuff_zero_ceiling_fires_on_seeded_sort_and_cumsum():
+    """The linear-BFT contract bites at ZERO: the real hotstuff round
+    with one bolted-on sort and one cumsum, compiled through the
+    production chunk jit at the canonical hotstuff-1k shape, violates
+    hotstuff's OWN declared 0/0 budgets — proving the dpos-class
+    ceiling fires on the first sort-class op, not after a grace
+    allowance."""
+    tgt = registry.target("hotstuff-1k")
+    eng = bad_engines.sorty_hotstuff_engine()
+    rep = hlo.compiled_report(tgt.cfg, eng)
+    assert rep.sort_ops >= 1 and rep.cumsum_ops >= 1
+    con = contracts.program_contracts()["hotstuff"]
+    assert con.sort_budget == 0 and con.cumsum_budget == 0
+    viols = contracts.check_module(
+        rep, con, tgt.cfg, mode=None, axis=None,
+        carry_leaves=hlo.n_carry_leaves(tgt.cfg, eng))
+    assert _contracts_hit(viols) == {"sort_budget"}
+    assert any("> budget 0" in v.message for v in viols)
+    # And the unmodified engine is the negative control: clean at 0/0.
+    from consensus_tpu.network import simulator
+    clean = hlo.compiled_report(tgt.cfg, simulator.engine_def(tgt.cfg))
+    assert clean.sort_ops == 0 and clean.cumsum_ops == 0
+
+
 def test_undonated_carry_fires_donation():
     viols = _violations(bad_engines.ok_engine,
                         jit_fn=bad_engines.undonated_chunk)

@@ -69,7 +69,7 @@ def test_committed_history_has_no_regressions():
     assert doc["regressions"] == [], doc["regressions"]
     # The known-stale pbft row reads stale-latest, never regression.
     verd = doc["series"]["pbft-100k-bcast@tpu"]["verdict"]
-    assert verd in ("stale-latest", "single-point")
+    assert verd in ("stale-latest", "new")
 
 
 def test_series_verdicts_synthetic():
@@ -92,18 +92,18 @@ def test_series_verdicts_synthetic():
     # correct measurement a regression.
     s = ledger.build_series([row("a", 100e6, stale="pre-fix row"),
                              row("a", 10e6, seq=2)])
-    assert s["a@tpu"]["verdict"] == "single-point"
+    assert s["a@tpu"]["verdict"] == "new"
     s = ledger.build_series([row("a", 100e6, stale="pre-fix row"),
                              row("a", 10e6, seq=2),
                              row("a", 9.5e6, seq=3)])
     assert s["a@tpu"]["verdict"] == "ok" and s["a@tpu"]["best_prior"] == 10e6
     s = ledger.build_series([row("a", 100e6)])
-    assert s["a@tpu"]["verdict"] == "single-point"
+    assert s["a@tpu"]["verdict"] == "new"
     # ok=false rows (failed/degenerate runs) never drive a verdict —
     # neither as a bogus 'latest' nor as an inflated 'best prior'.
     s = ledger.build_series([row("a", 100e6),
                              row("a", 1e6, seq=2, ok=False)])
-    assert s["a@tpu"]["verdict"] == "single-point"
+    assert s["a@tpu"]["verdict"] == "new"
     s = ledger.build_series([row("a", 500e6, ok=False),
                              row("a", 100e6, seq=2),
                              row("a", 98e6, seq=3)])
@@ -126,7 +126,7 @@ def test_series_verdicts_synthetic():
     s = ledger.build_series([row("a", 100e6), row("a", 1e6, plat="cpu",
                                                   seq=2)])
     assert set(s) == {"a@tpu", "a@cpu"}
-    assert all(v["verdict"] == "single-point" for v in s.values())
+    assert all(v["verdict"] == "new" for v in s.values())
 
 
 def test_bench_trajectory_block_ingested_directly(tmp_path):

@@ -6,7 +6,16 @@
         [--findings-out findings.json] [--trace-out t.jsonl]
     python -m tools.advsearch distill --state-dir DIR --finding K \\
         --name NAME [--catalog PATH]
+    python -m tools.advsearch report --state-dir DIR [--out PATH]
     python -m tools.advsearch smoke [--trace-out t.jsonl]
+
+`report` writes a search state's findings — in particular §A.3
+attack-space (TPU-only, unmirrored) findings, which can never be
+oracle-confirmed and so can never enter the distilled catalog — to the
+standalone attack-findings artifact (default
+benchmarks/parts/attack_findings.json), OUTSIDE
+scenarios/discovered.json: an attack search ends in a committed
+report, not a distill refusal.
 
 `search` runs on whatever JAX backend is up (the smoke gate pins
 JAX_PLATFORMS=cpu); one generation = one compiled-program dispatch per
@@ -83,15 +92,10 @@ def cmd_search(args) -> int:
 def cmd_distill(args) -> int:
     from consensus_tpu import scenarios as scen
 
-    from .search import SPACES, distill, load_state, write_catalog
+    from .search import distill, write_catalog
     # Reload by recorded identity: the state file names its own space/
     # seed/population, so distill needs only the directory.
-    doc = json.loads(
-        (pathlib.Path(args.state_dir) / "search_state.json").read_text())
-    st = load_state(args.state_dir, SPACES[doc["space"]],
-                    doc["search_seed"], doc["population"])
-    if st is None:
-        raise SystemExit(f"advsearch: no search state in {args.state_dir}")
+    st = _load_state_by_identity(args.state_dir)
     if not st.findings:
         raise SystemExit("advsearch: the search recorded no findings — "
                          "nothing to distill")
@@ -107,6 +111,56 @@ def cmd_distill(args) -> int:
          f"(oracle digest {entry['finding']['oracle']['digest'][:16]}…); "
          f"run it with: consensus-sim --scenario {args.name}")
     print(json.dumps(entry["scenario"]))
+    return 0
+
+
+DEFAULT_REPORT = "benchmarks/parts/attack_findings.json"
+
+
+def _load_state_by_identity(state_dir):
+    """Reload a state file by its own recorded identity (space/seed/
+    population) — shared by distill and report."""
+    from .search import SPACES, load_state
+    doc = json.loads(
+        (pathlib.Path(state_dir) / "search_state.json").read_text())
+    st = load_state(state_dir, SPACES[doc["space"]], doc["search_seed"],
+                    doc["population"])
+    if st is None:
+        raise SystemExit(f"advsearch: no search state in {state_dir}")
+    return st
+
+
+def cmd_report(args) -> int:
+    from tools.validate_trace import validate_finding_doc
+
+    from .search import SPACES, write_attack_report
+    st = _load_state_by_identity(args.state_dir)
+    if not st.findings:
+        raise SystemExit("advsearch: the search recorded no findings — "
+                         "nothing to report")
+    out = args.out or str(
+        pathlib.Path(__file__).resolve().parents[2] / DEFAULT_REPORT)
+    # The entry's findings obey the same schema the findings artifact
+    # does — reject a drifted state file rather than commit it.
+    errs = validate_finding_doc("report", {
+        "version": 1, "space": st.space, "search_seed": st.search_seed,
+        "generations": st.generations_done, "findings": st.findings})
+    if errs:
+        for e in errs:
+            _log(f"FAIL: {e}")
+        return 1
+    entry = write_attack_report(st, out)
+    sp = SPACES[st.space]
+    kind = ("oracle-mirrored" if sp.mirrored
+            else "TPU-only, unmirrored — outside the distilled catalog "
+                 "by design")
+    _log(f"{len(st.findings)} findings from space {st.space!r} "
+         f"({kind}) reported to {out}")
+    print(json.dumps({"space": entry["space"],
+                      "search_seed": entry["search_seed"],
+                      "mirrored": entry["mirrored"],
+                      "findings": len(entry["findings"]),
+                      "out": out}))
     return 0
 
 
@@ -225,6 +279,18 @@ def main(argv=None) -> int:
                    help="catalog JSON path (default: the package's "
                         "consensus_tpu/scenarios/discovered.json)")
 
+    r = sub.add_parser("report",
+                       help="write a search state's findings to the "
+                            "standalone attack-findings artifact — the "
+                            "§A.3 (TPU-only, unmirrored) route that "
+                            "cannot pass through the oracle-confirmed "
+                            "distilled catalog")
+    r.add_argument("--state-dir", required=True)
+    r.add_argument("--out", default="",
+                   help=f"report JSON path (default <repo>/"
+                        f"{DEFAULT_REPORT}; entries keyed by "
+                        "(space, search_seed), atomic replace)")
+
     m = sub.add_parser("smoke",
                        help="fixed tiny-budget search + one-program-"
                             "per-generation self-check (the `make "
@@ -236,7 +302,8 @@ def main(argv=None) -> int:
         ap.error("--resume needs --state-dir (there is no state to "
                  "resume without one)")
     return {"spaces": cmd_spaces, "search": cmd_search,
-            "distill": cmd_distill, "smoke": cmd_smoke}[args.cmd](args)
+            "distill": cmd_distill, "report": cmd_report,
+            "smoke": cmd_smoke}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -113,12 +113,21 @@ SPACES: dict[str, Space] = {s.name: s for s in (
         description="DPoS slot misses composed with heavy lossy/delayed "
                     "delivery and churn (crash machinery OFF — the "
                     "hand-built rolling-producer-outage owns that axis): "
-                    "hunting LIB stalls at miss_rate well below 1/3.",
+                    "hunting LIB stalls at miss_rate well below 1/2. "
+                    "Shape per the ROADMAP reshape: a SMALL producer set "
+                    "(K = 3 ⇒ the LIB threshold T = 2K/3+1 = 3 equals "
+                    "K, so ONE producer going stale at the head stalls "
+                    "irreversibility) over LONG suffix windows "
+                    "(epoch_len 48 pins the same set for half the run, "
+                    "so a gappy producer cannot be rotated out from "
+                    "under its own gap) — the old K = 6 / epoch 16 "
+                    "shape needed two simultaneously-stale producers "
+                    "and never dropped lib_ratio below ~0.85.",
         base=Config(protocol="dpos", n_nodes=24, log_capacity=96,
-                    n_candidates=12, n_producers=6,
+                    n_candidates=12, n_producers=3, epoch_len=48,
                     drop_rate=0.3, miss_rate=0.1, max_delay_rounds=4,
                     churn_rate=0.01, **_ADV),
-        knobs=(KnobRange("miss_rate", 0.02, 0.33),
+        knobs=(KnobRange("miss_rate", 0.05, 0.50),
                KnobRange("drop_rate", 0.05, 0.60),
                KnobRange("churn_rate", 0.0, 0.10))),
     Space(
@@ -554,6 +563,59 @@ def _confirm(space: Space, knobs: dict[str, float], seed: int) -> dict:
             **({} if ok else {"oracle_digest": cpu.digest})}
 
 
+# --- §A.3 attack-space reports ----------------------------------------------
+#
+# Findings from UNMIRRORED spaces (the SPEC §A.3 targeted attacks are
+# TPU-engine-only — the C++ oracle deliberately does not mirror them)
+# can never be oracle-confirmed, so they can never enter the distilled
+# scenario catalog (scenarios/discovered.json). They are still results:
+# the report path below writes them to a separate artifact OUTSIDE the
+# catalog — same finding schema (FINDING_FIELDS, validate_trace
+# --finding checks it), explicit unconfirmed-oracle provenance — so an
+# attack-space search ends in a committed report, not a refusal.
+
+ATTACK_REPORT_VERSION = 1
+
+
+def attack_report_doc(st: SearchState) -> dict:
+    """One search state's findings as a standalone §A.3 report entry.
+    Works for any space; the subcommand routes unmirrored spaces here
+    because distill() must refuse them."""
+    sp = SPACES[st.space]
+    return {"space": st.space, "protocol": sp.base.protocol,
+            "mirrored": sp.mirrored, "search_seed": st.search_seed,
+            "population": st.population,
+            "generations": st.generations_done,
+            "base_config": json.loads(sp.base.to_json()),
+            "knobs": [[k.field, k.lo, k.hi] for k in sp.knobs],
+            "coverage_cells": len(st.coverage),
+            "findings": st.findings}
+
+
+def write_attack_report(st: SearchState, path) -> dict:
+    """Append (or replace, keyed by (space, search_seed)) one report
+    entry in the attack-findings artifact. Atomic, sorted — the same
+    write discipline as the discovered catalog. Returns the entry."""
+    entry = attack_report_doc(st)
+    p = pathlib.Path(path)
+    doc = {"version": ATTACK_REPORT_VERSION, "reports": []}
+    if p.exists():
+        doc = json.loads(p.read_text())
+        if doc.get("version") != ATTACK_REPORT_VERSION:
+            raise ValueError(f"{p}: report version "
+                             f"{doc.get('version')!r} != "
+                             f"{ATTACK_REPORT_VERSION}")
+    key = (entry["space"], entry["search_seed"])
+    doc["reports"] = [e for e in doc["reports"]
+                      if (e["space"], e["search_seed"]) != key] + [entry]
+    doc["reports"].sort(key=lambda e: (e["space"], e["search_seed"]))
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp.json")
+    tmp.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    tmp.replace(p)
+    return entry
+
+
 # --- distillation -----------------------------------------------------------
 
 def _bounds_from_metrics(m: dict[str, Any]) -> dict[str, Any]:
@@ -614,7 +676,10 @@ def distill(st: SearchState, finding_index: int, name: str,
         raise ValueError(
             f"space {space.name!r} searches TPU-only knobs (SPEC §A.3 "
             "targeted attacks) — its findings cannot be oracle-"
-            "confirmed, so they cannot enter the distilled catalog")
+            "confirmed, so they cannot enter the distilled catalog; "
+            "report them instead: `python -m tools.advsearch report "
+            "--state-dir ...` writes them to the attack-findings "
+            "artifact outside scenarios/discovered.json")
     oracle = f["oracle"]
     if oracle.get("confirmed") is None:
         oracle = _confirm(space, f["knobs"], f["eval_seed"])

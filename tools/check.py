@@ -116,16 +116,24 @@ def layer_tidy(_: argparse.Namespace) -> str:
     return "FAIL" if _run(["make", "-C", "cpp", "tidy"]) else "ok"
 
 
-# The `make check` scenario smoke: ONE small scripted-attack run
-# through the real CLI front door, timeline assertions judged by the
-# scenario's own exit status (consensus_tpu/scenarios). The shape IS
-# delay-storm's declared `tuned` reference shape — the one its bounds
-# are verified at — so a smoke red is a real regression, never the
-# off-tuned case the CLI hint disclaims; tests reuse this exact flag
-# list (test_python_cli_scenario_verdict) so the two can't drift.
+# The `make check` scenario smokes: small scripted-attack runs through
+# the real CLI front door, timeline assertions judged by the scenario's
+# own exit status (consensus_tpu/scenarios). Each shape IS the
+# scenario's declared `tuned` reference shape — the one its bounds are
+# verified at — so a smoke red is a real regression, never the
+# off-tuned case the CLI hint disclaims; tests reuse these exact flag
+# lists (test_python_cli_scenario_verdict /
+# test_python_cli_hotstuff_smoke_verdict) so the two can't drift.
 SCENARIO_SMOKE = ["-m", "consensus_tpu", "--scenario", "delay-storm",
                   "--protocol", "raft", "--nodes", "7", "--rounds", "96",
                   "--log-capacity", "32", "--max-entries", "24",
+                  "--sweeps", "2", "--seed", "11", "--platform", "cpu"]
+
+# The linear-BFT smoke: the chained-commit stall under the PR 10 delay
+# stream + §6c leader outages, through the hotstuff engine (SPEC §7b).
+HOTSTUFF_SMOKE = ["-m", "consensus_tpu", "--scenario",
+                  "chained-commit-stall", "--protocol", "hotstuff",
+                  "--f", "2", "--rounds", "96", "--log-capacity", "96",
                   "--sweeps", "2", "--seed", "11", "--platform", "cpu"]
 
 
@@ -134,8 +142,10 @@ def layer_scenarios(_: argparse.Namespace) -> str:
     if importlib.util.find_spec("jax") is None:
         return "SKIP (jax not installed)"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    return "FAIL" if _run([sys.executable] + SCENARIO_SMOKE, env=env) \
-        else "ok"
+    for smoke in (SCENARIO_SMOKE, HOTSTUFF_SMOKE):
+        if _run([sys.executable] + smoke, env=env):
+            return "FAIL"
+    return "ok"
 
 
 def layer_advsearch(_: argparse.Namespace) -> str:

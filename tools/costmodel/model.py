@@ -222,14 +222,21 @@ HBM_PER_DEVICE_BYTES = 16 * 1024**3  # v5e: 16 GB HBM per chip
 # Targets whose engines declare a node-sharded claim (hlocheck
 # contracts) — the ones a >1-chip mesh can actually scale on the node
 # axis, and therefore the ones worth projecting past 100k nodes.
-SCALE_TARGETS = ("raft-100k", "dpos-100k")
+SCALE_TARGETS = ("raft-100k", "dpos-100k", "hotstuff-100k")
 
 
 def _scaled_carry_bytes(cfg, n: int) -> int:
     import dataclasses
 
     from benchmarks.run_benchmarks import carry_nbytes
-    return carry_nbytes(dataclasses.replace(cfg, n_nodes=n))
+    changes: dict = {"n_nodes": n}
+    if cfg.protocol in ("pbft", "hotstuff"):
+        # BFT populations must be 3f+1: snap the projection point to
+        # the nearest valid shape at or above n (the carry differs by
+        # O(1) node rows — noise at these scales).
+        f = -(-(n - 1) // 3)
+        changes.update(f=f, n_nodes=3 * f + 1)
+    return carry_nbytes(dataclasses.replace(cfg, **changes))
 
 
 def _collective_bytes_per_round(card: dict) -> int:

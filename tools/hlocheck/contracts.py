@@ -49,7 +49,7 @@ CONTRACT_NAMES = ("collectives", "sort_budget", "dtypes",
                   "host_boundary", "donation")
 
 _ENGINE_MODULES = ("raft", "raft_sparse", "pbft", "pbft_bcast",
-                   "paxos", "dpos")
+                   "paxos", "dpos", "hotstuff")
 
 _MODES = (None, "zero", "bounded", "strict")
 

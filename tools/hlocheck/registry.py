@@ -82,6 +82,16 @@ CAPPED_1K = Config(protocol="raft", n_nodes=1024, n_rounds=8, n_sweeps=2,
 PBFT_1K_DENSE = Config(protocol="pbft", f=341, n_nodes=1024, n_rounds=32,
                        n_sweeps=2, log_capacity=16, seed=3, **ADV)
 
+# The canonical hotstuff shape at the mesh-divisible population
+# (N = 3·341+1 = 1024): where the engine's "bounded" node-sharded claim
+# is established — the [N] per-node leaves shard, the vote count is one
+# psum, and every collective stays O(N) metadata (there is no [N, S]
+# carry leaf to gather). Checked under both (2, 4) and (1, 8) meshes
+# like raft-1k-cap8.
+HOTSTUFF_1K = Config(protocol="hotstuff", f=341, n_nodes=1024,
+                     n_rounds=32, n_sweeps=2, log_capacity=32, seed=9,
+                     **ADV)
+
 # The one-program §6b f-ladder at the flagship population: rungs pad to
 # N_pad = 3·33333+1 = 100k, the pbft-100k-bcast shape — so the program
 # that serves `--fault-model bcast --f-sweep ...` (the lifted carve-out,
@@ -113,6 +123,9 @@ def targets() -> tuple[Target, ...]:
                (SINGLE, Variant("node2x4", (2, 4), "bounded", "node"),
                 SWEEP8)),
         Target("pbft-100k-bcast", F["pbft-100k-bcast"], (SINGLE, SWEEP8)),
+        Target("hotstuff-100k", F["hotstuff-100k"],
+               (SINGLE, SWEEP8,
+                Variant("node1x8", (1, 8), "bounded", "node"))),
         Target("pbft-100k-bcast-flight", PBFT_BCAST_FLIGHT, (SINGLE,),
                flight=True),
         Target("pbft-100k-bcast-fsweep", PBFT_BCAST_FSWEEP, (SINGLE,),
@@ -125,6 +138,10 @@ def targets() -> tuple[Target, ...]:
                 Variant("node2x4", (2, 4), "strict", "node"),
                 Variant("node1x8", (1, 8), "strict", "node"))),
         Target("pbft-1k-dense", PBFT_1K_DENSE, (SINGLE,)),
+        Target("hotstuff-1k", HOTSTUFF_1K,
+               (SINGLE,
+                Variant("node2x4", (2, 4), "bounded", "node"),
+                Variant("node1x8", (1, 8), "bounded", "node"))),
     )
 
 

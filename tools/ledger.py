@@ -264,7 +264,9 @@ def _point_order(row: dict) -> tuple:
 def build_series(rows: list[dict]) -> dict[str, dict]:
     """Per-(name, platform-class) measurement series + noise-banded
     verdict: points ordered chronologically (:func:`_point_order`), the
-    LATEST compared against the best EARLIER one."""
+    LATEST compared against the best EARLIER one. A series with a
+    single (non-stale) point verdicts ``new`` — shielded from both
+    regression directions until a second measurement exists."""
     groups: dict[str, list[dict]] = {}
     for row in rows:
         # ok=false rows (failed rounds, degenerate nothing-committed
@@ -291,8 +293,12 @@ def build_series(rows: list[dict]) -> dict[str, dict]:
         entry: dict[str, Any] = {"n_points": len(grp), "points": pts,
                                  "latest": latest["steps_per_sec"]}
         if not prior:
-            entry.update(verdict="single-point", best_prior=None,
-                         ratio=None)
+            # A series whose only (non-stale) point is the latest one is
+            # NEW: it can neither regress nor serve as evidence that
+            # anything else did — the first RESULTS/cost-card rows of a
+            # freshly landed config (e.g. hotstuff-100k) get a neutral
+            # verdict instead of faking either direction.
+            entry.update(verdict="new", best_prior=None, ratio=None)
         else:
             best = max(r["steps_per_sec"] for r in prior)
             ratio = latest["steps_per_sec"] / best
@@ -363,7 +369,7 @@ def main(argv=None) -> int:
     for s in doc["stale_rows"]:
         log(f"STALE {s['name']} ({s['source']}): {s['note']}")
     for key, s in doc["series"].items():
-        if s["verdict"] != "single-point":
+        if s["verdict"] != "new":
             log(f"{key}: latest {s['latest'] / 1e6:.2f}M vs best prior "
                 f"{s['best_prior'] / 1e6:.2f}M ({s['ratio']:.2f}x) "
                 f"-> {s['verdict']}")

@@ -43,6 +43,9 @@ TELEMETRY_COUNTERS = frozenset({
     "blocks_appended", "missed_appends", "producer_rotations", "churn_slots",
     # dpos per-producer slot faults (SPEC §A.1)
     "missed_slots",
+    # hotstuff (SPEC §7b; view_changes is shared with pbft above)
+    "qc_formed", "blocks_committed", "commits_learned",
+    "proposals_delivered", "votes_counted",
     # crash-recover adversary (SPEC §6c, every engine)
     "crashes", "recoveries", "nodes_down",
 })
@@ -54,12 +57,14 @@ TELEMETRY_COUNTERS = frozenset({
 LATENCY_HISTOGRAMS = frozenset({
     # raft (dense + sparse)
     "election_wait_rounds", "commit_lag_rounds",
-    # pbft (edge + bcast)
+    # pbft (edge + bcast); view_change_wait_rounds shared with hotstuff
     "view_change_wait_rounds", "slot_commit_rounds",
     # paxos
     "rounds_to_learn",
     # dpos
     "chain_lag_rounds",
+    # hotstuff (SPEC §7b): chained-pipeline depth head - committed
+    "chain_commit_lag_rounds",
 })
 
 # Flight-recorder bucket semantics (ops/flight.py): bucket 0 holds
@@ -155,7 +160,11 @@ LEDGER_ROW_FIELDS = frozenset({
 })
 _LEDGER_KINDS = frozenset({"results-tpu", "results-oracle", "driver-bench",
                            "multichip-dryrun"})
-_LEDGER_VERDICTS = frozenset({"ok", "regression", "single-point",
+# "new" = a single-point series (first measurement of a fresh config —
+# shielded from both regression directions); "single-point" is the
+# pre-rename alias, still accepted so committed LEDGER.json artifacts
+# from older trees validate.
+_LEDGER_VERDICTS = frozenset({"ok", "regression", "new", "single-point",
                               "stale-latest"})
 
 _SCALAR = (bool, int, float, str, type(None))
