@@ -2,7 +2,7 @@
 #
 #   make check     # consensus-lint + hlocheck + costcheck + ruff + mypy
 #                  # + clang-tidy + scenario smoke + advsearch smoke
-#                  # + tier-1
+#                  # + sweepd service smoke + tier-1
 #   make ledger    # cross-run perf ledger + regression verdict
 #
 # (tools/check.py gates hlocheck on jax and ruff/mypy/clang-tidy on
@@ -35,6 +35,9 @@ scenario-smoke:
 advsearch-smoke:
 	$(PY) tools/check.py --only advsearch
 
+service-smoke:
+	$(PY) tools/check.py --only service
+
 san-test:
 	$(MAKE) -C cpp san-test
 
@@ -44,4 +47,4 @@ test:
 	  -p no:xdist -p no:randomly
 
 .PHONY: check lint hlocheck costcheck ledger tidy san-test scenario-smoke \
-	advsearch-smoke test
+	advsearch-smoke service-smoke test

@@ -201,6 +201,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "ephemeral port; the bound port is printed to "
                          "stderr. TPU engine only (the gauges are the "
                          "chunk loop's)")
+    ap.add_argument("--submit", default="", metavar="URL",
+                    help="client mode: instead of running locally, POST "
+                         "the flag-built config (plus --scenario, when "
+                         "given) as a job to a sweepd service at URL "
+                         "(e.g. http://127.0.0.1:8787, `python -m "
+                         "consensus_tpu.service`) and print the job id; "
+                         "execution-local flags (--checkpoint, "
+                         "--retries, --f-sweep, ...) are rejected — the "
+                         "service owns execution (docs/SERVICE.md)")
+    ap.add_argument("--submit-wait", action="store_true",
+                    help="with --submit: poll /jobs/<id> until the job "
+                         "finishes and print its final document; exit 0 "
+                         "on done (3 on a failed scenario verdict, 1 on "
+                         "a failed job)")
+    ap.add_argument("--job-name", default="",
+                    help="with --submit: display name for the job "
+                         "(default: derived from the config shape)")
     ap.add_argument("--config", default="",
                     help="JSON config file; typed flags override its values")
     ap.add_argument("--platform", default="auto",
@@ -310,10 +327,114 @@ def args_to_config(args):
     return Config(**fields)
 
 
+def _submit_job(cfg, args, parser) -> int:
+    """--submit: the sweepd client mode. Validation is the service's
+    job (admission 400s come back as clean one-liners); this side only
+    refuses flags that ask for LOCAL execution machinery the service
+    owns (no silent ignores)."""
+    import urllib.error
+    import urllib.request
+
+    rejected = [name for name, on in [
+        ("--checkpoint", args.checkpoint),
+        ("--group-dir", args.group_dir),
+        ("--f-sweep", bool(args.f_sweep)),
+        ("--retries/--deadline/--fallback-cpu",
+         bool(args.retries or args.deadline or args.fallback_cpu)),
+        ("--profile", args.profile),
+        ("--serve-port", args.serve_port is not None),
+        ("--trace-out", args.trace_out),
+        ("--metrics-out", args.metrics_out),
+        ("--out", args.out),
+        ("--oracle-delivery", args.oracle_delivery != "auto"),
+    ] if on]
+    if rejected:
+        parser.error(f"{', '.join(rejected)}: local-execution flags do "
+                     "not apply to --submit (the service owns "
+                     "checkpoints, supervision and artifacts — "
+                     "docs/SERVICE.md)")
+
+    base = args.submit.rstrip("/")
+    body: dict = {"config": json.loads(cfg.to_json())}
+    if args.scenario:
+        body["scenario"] = args.scenario
+    if args.job_name:
+        body["name"] = args.job_name
+
+    def _call(url: str, data: bytes | None = None) -> dict:
+        req = urllib.request.Request(
+            url, data=data, method="POST" if data else "GET",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read().decode())
+
+    try:
+        doc = _call(base + "/jobs", json.dumps(body).encode())
+    except urllib.error.HTTPError as exc:
+        try:
+            msg = json.loads(exc.read().decode()).get("error", str(exc))
+        except ValueError:
+            msg = str(exc)
+        print(f"submit: service rejected the job: {msg}", file=sys.stderr)
+        return 2
+    except urllib.error.URLError as exc:
+        print(f"submit: cannot reach {base}: {exc.reason} (is sweepd "
+              "running? `python -m consensus_tpu.service --port P`)",
+              file=sys.stderr)
+        return 2
+    if not args.submit_wait:
+        print(json.dumps({"id": doc["id"], "status": doc["status"],
+                          "name": doc["name"],
+                          "url": f"{base}/jobs/{doc['id']}"}))
+        return 0
+    import time as _time
+    # No overall deadline (jobs are legitimately long; the durable
+    # queue means the job outlives this client anyway) — but transient
+    # poll failures get a bounded tolerance instead of a raw traceback,
+    # and a persistently-gone service is a clean exit, not a hang.
+    failing_since = None
+    while True:
+        try:
+            job = _call(f"{base}/jobs/{doc['id']}")
+            failing_since = None
+        except urllib.error.URLError as exc:
+            now = _time.monotonic()
+            failing_since = failing_since or now
+            if now - failing_since > 30.0:
+                reason = getattr(exc, "reason", exc)
+                print(f"submit: lost {base} while waiting on "
+                      f"{doc['id']} ({reason}); the job survives in "
+                      "the service's durable queue — poll "
+                      f"{base}/jobs/{doc['id']} once it is back",
+                      file=sys.stderr)
+                return 2
+            _time.sleep(1.0)
+            continue
+        if job.get("status") in ("done", "failed"):
+            break
+        _time.sleep(0.2)
+    print(json.dumps(job))
+    if job["status"] != "done":
+        return 1
+    verdict = (job.get("result") or {}).get("scenario")
+    return 0 if verdict is None or verdict.get("passed") else 3
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     cfg = args_to_config(args)
+
+    if args.submit_wait and not args.submit:
+        parser.error("--submit-wait requires --submit")
+    if args.job_name and not args.submit:
+        parser.error("--job-name requires --submit (it names the "
+                     "service-side job, nothing local)")
+    if args.submit:
+        # Client mode: the scenario (if any) is applied — and the
+        # config re-validated — by the service at admission, so the
+        # flag-built config ships as-is.
+        return _submit_job(cfg, args, parser)
 
     if args.scenario:
         from . import scenarios
@@ -527,11 +648,11 @@ def _start_server(cfg, args, platform_tag: str, report_holder: dict):
     try:
         server = obs_serve.MetricsServer(args.serve_port, status=status)
     except OSError as exc:
-        # EADDRINUSE and friends: a clean diagnostic, not a traceback —
-        # and no simulation ran, so nothing is half-done.
-        print(f"serve: cannot bind 127.0.0.1:{args.serve_port}: {exc} "
-              f"(pick another --serve-port, or 0 for an ephemeral one)",
-              file=sys.stderr, flush=True)
+        # A busy port arrives as obs_serve.PortInUseError (an OSError)
+        # whose str() is already the actionable one-liner; any other
+        # bind failure gets the same clean-diagnostic treatment — no
+        # traceback, and no simulation ran, so nothing is half-done.
+        print(f"serve: {exc}", file=sys.stderr, flush=True)
         raise SystemExit(2)
     print(f"serve: listening on http://127.0.0.1:{server.port} "
           f"(/metrics, /status)", file=sys.stderr, flush=True)

@@ -112,6 +112,7 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                    mesh=None, seeds=None,
                    warmup: bool = False, telemetry: bool = False,
                    oracle_delivery: str = "auto",
+                   progress=None,
                    sleep=time.sleep):
     """Run ``cfg`` under supervision; return the :class:`RunResult` with
     ``extras["run_report"]`` filled in.
@@ -156,6 +157,13 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
     not steady-state timing, so the compile-then-rerun warmup of
     :func:`simulator.run` is skipped; ``RunResult.timing_includes_compile``
     is set accordingly.
+
+    ``progress`` (tpu engine only; a callable receiving one info dict
+    per chunk, :func:`consensus_tpu.network.runner._advance`) rides
+    every attempt — the sweep service's per-JOB live gauges need the
+    per-chunk round/ETA signal even while the supervisor is the one
+    driving the run, and a retried attempt keeps reporting through the
+    same callback.
 
     ``telemetry=True`` enables the tpu engine's on-device protocol
     counters (``RunResult.extras["telemetry"]``, docs/OBSERVABILITY.md).
@@ -220,6 +228,11 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
         raise ValueError("telemetry is reduced inside the tpu engine's "
                          f"scan body (cfg.engine={cfg.engine!r} has no "
                          "on-device counters)")
+    if progress is not None and cfg.engine != "tpu":
+        raise ValueError("progress reports the tpu engine's per-chunk "
+                         f"round/ETA signal (cfg.engine={cfg.engine!r} "
+                         "runs as one oracle call and would silently "
+                         "never call it)")
     if oracle_delivery != "auto" and cfg.engine != "cpu":
         raise ValueError("oracle_delivery is a cpu-oracle execution knob "
                          f"(cfg.engine={cfg.engine!r}); simulator.run would "
@@ -260,6 +273,8 @@ def supervised_run(cfg: Config, *, retries: int = 2, backoff_s: float = 0.5,
                 kw["mesh"] = mesh
             if seeds is not None:
                 kw["seeds"] = seeds
+            if progress is not None:
+                kw["progress"] = progress
         t0 = time.monotonic()
         try:
             with obs_trace.span("supervised_attempt", index=attempt,

@@ -26,6 +26,12 @@ Snapshot schema (version 1):
               — run-identity labels (the Prometheus info-metric
               convention: rendered as `name{k="v",...} 1`, label
               values escaped per the text exposition format).
+  labeled_gauge : {"type": "labeled_gauge",
+                   "series": [{"labels": {k: str}, "value": number}]}
+              — one gauge family, one child per label set (the sweep
+              service's per-job rounds_completed/eta gauges); rendered
+              as `name{k="v",...} value` per child, series sorted by
+              label string so snapshots are deterministic.
 
 Tests (and the benchmark suite, which wants a per-config delta) use
 :func:`reset` to zero the default registry.
@@ -146,6 +152,57 @@ class Info:
         return {"type": "info", "labels": dict(sorted(self.labels.items()))}
 
 
+class LabeledGauge:
+    """A gauge FAMILY: one last-write-wins value per label set (the
+    Prometheus child-metric convention). Used for per-job fleet gauges
+    (``service_job_rounds_completed{job="j0003"}``) where one process
+    tracks many concurrent runs — a plain :class:`Gauge` would
+    last-write-scramble them. Children are keyed by the sorted label
+    items; :meth:`remove` drops a child (e.g. a finished job) so the
+    family stays bounded over a long-lived service.
+
+    Writes REBIND ``_series`` to a fresh dict (copy-on-write) instead
+    of mutating in place: like Gauge/Info's single reference
+    assignment, that keeps a concurrent /metrics scrape's snapshot
+    iteration safe without putting a lock on the per-chunk hot path —
+    an in-place insert from the worker thread mid-iteration would be
+    a 'dict changed size' crash in the scraper."""
+
+    __slots__ = ("_series",)
+
+    def __init__(self) -> None:
+        self._series: dict[tuple, tuple[dict[str, str], int | float]] = {}
+
+    @staticmethod
+    def _key(labels: dict[str, str]) -> tuple:
+        return tuple(sorted(labels.items()))
+
+    def set(self, value: int | float, **labels: Any) -> None:
+        if not labels:
+            raise ValueError("labeled gauge needs at least one label "
+                             "(use a plain gauge otherwise)")
+        if not _PAUSED:
+            lab = {k: str(v) for k, v in labels.items()}
+            self._series = {**self._series, self._key(lab): (lab, value)}
+
+    def get(self, **labels: Any) -> int | float | None:
+        lab = {k: str(v) for k, v in labels.items()}
+        entry = self._series.get(self._key(lab))
+        return None if entry is None else entry[1]
+
+    def remove(self, **labels: Any) -> None:
+        lab = {k: str(v) for k, v in labels.items()}
+        key = self._key(lab)
+        if key in self._series:
+            self._series = {k: v for k, v in self._series.items()
+                            if k != key}
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"type": "labeled_gauge",
+                "series": [{"labels": dict(lab), "value": v}
+                           for _, (lab, v) in sorted(self._series.items())]}
+
+
 def escape_label_value(v: str) -> str:
     """Prometheus text-format label-value escaping: backslash, double
     quote and newline must be escaped or the exposition line is
@@ -191,6 +248,9 @@ class Registry:
     def info(self, name: str) -> Info:
         return self._get(name, Info)
 
+    def labeled_gauge(self, name: str) -> LabeledGauge:
+        return self._get(name, LabeledGauge)
+
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()
@@ -209,6 +269,15 @@ class Registry:
                 # run identity in (escaped) labels.
                 out.append(f"# TYPE {name} gauge")
                 out.append(f"{name}{{{_label_str(d['labels'])}}} 1")
+                continue
+            if d["type"] == "labeled_gauge":
+                # One child line per label set; the TYPE line calls the
+                # family a gauge (Prometheus has no labeled_gauge type —
+                # labels are the child convention, like info above).
+                out.append(f"# TYPE {name} gauge")
+                for child in d["series"]:
+                    out.append(f"{name}{{{_label_str(child['labels'])}}} "
+                               f"{child['value']}")
                 continue
             out.append(f"# TYPE {name} {d['type']}")
             if d["type"] in ("counter", "gauge"):
@@ -232,6 +301,7 @@ counter = REGISTRY.counter
 gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram
 info = REGISTRY.info
+labeled_gauge = REGISTRY.labeled_gauge
 reset = REGISTRY.reset
 snapshot = REGISTRY.snapshot
 to_prometheus = REGISTRY.to_prometheus

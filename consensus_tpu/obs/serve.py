@@ -13,6 +13,12 @@ The first brick of sweep-as-a-service (ROADMAP): a stdlib
                 updates per chunk, plus the supervised RunReport once
                 one exists.
 
+``routes`` extends the same server with caller-defined endpoints —
+the sweep-service daemon (:mod:`consensus_tpu.service`) mounts its
+``/jobs`` API here rather than growing a second HTTP stack, so both
+front doors share one handler, one shutdown path, and one bind-error
+policy.
+
 Entirely OFF the hot path: the chunk loop only touches the gauges it
 already updates; each request reads a locked registry snapshot on the
 server thread. Binds 127.0.0.1 only (introspection, not a public
@@ -21,6 +27,7 @@ in ``MetricsServer.port`` and on the stderr banner the CLI prints.
 """
 from __future__ import annotations
 
+import errno
 import json
 import sys
 import threading
@@ -31,6 +38,23 @@ from typing import Any, Callable
 from . import metrics
 
 StatusFn = Callable[[], "dict[str, Any]"]
+# A mounted route: (method, path, body) -> (http status, content type,
+# response bytes). Mounted by path PREFIX (longest match wins), so one
+# route can serve a whole subtree ("/jobs" also answers "/jobs/j0001").
+RouteFn = Callable[[str, str, bytes], "tuple[int, str, bytes]"]
+
+
+class PortInUseError(OSError):
+    """The requested port is already bound. Raised instead of the raw
+    ``OSError`` traceback so every front door (the CLIs' --serve-port,
+    the service daemon's --port) reports the same actionable line —
+    str(exc) is the user-facing message."""
+
+    def __init__(self, host: str, port: int) -> None:
+        super().__init__(
+            errno.EADDRINUSE,
+            f"cannot bind {host}:{port}: the port is already in use "
+            f"(pick another port, or 0 for an ephemeral one)")
 
 
 class _QuietServer(ThreadingHTTPServer):
@@ -56,16 +80,33 @@ class MetricsServer:
 
     ``status`` supplies the /status payload's run-identity fields; the
     live gauge values are merged in at request time so the endpoint
-    never goes through the run loop. Use as a context manager or call
-    :meth:`close`.
+    never goes through the run loop. ``routes`` mounts additional
+    endpoints by path prefix (see :data:`RouteFn`) — GET and POST both
+    dispatch through them; built-in paths win over a mounted prefix.
+    Use as a context manager or call :meth:`close` (idempotent: the
+    server thread is shut down and JOINED exactly once, so a daemon
+    exiting through overlapping finally blocks never double-closes a
+    dead socket).
+
+    A busy port raises :class:`PortInUseError` (an OSError subclass,
+    so existing handlers keep working) with a one-line actionable
+    message instead of the raw bind traceback.
     """
 
     def __init__(self, port: int = 0, status: StatusFn | None = None,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1",
+                 routes: "dict[str, RouteFn] | None" = None) -> None:
         self._status = status
+        self._routes = dict(routes or {})
         self._t0 = time.time()
+        self._closed = False
         handler = self._make_handler()
-        self._httpd = _QuietServer((host, port), handler)
+        try:
+            self._httpd = _QuietServer((host, port), handler)
+        except OSError as exc:
+            if exc.errno == errno.EADDRINUSE:
+                raise PortInUseError(host, port) from exc
+            raise
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="metrics-server",
             daemon=True)
@@ -83,27 +124,53 @@ class MetricsServer:
         doc["uptime_s"] = round(time.time() - self._t0, 3)
         return doc
 
+    def _route_for(self, path: str) -> RouteFn | None:
+        best = None
+        for prefix in self._routes:
+            if (path == prefix or path.startswith(prefix + "/")) \
+                    and (best is None or len(prefix) > len(best)):
+                best = prefix
+        return None if best is None else self._routes[best]
+
     def _make_handler(self) -> type:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                if self.path == "/metrics":
-                    body = metrics.to_prometheus().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path == "/status":
-                    body = (json.dumps(server.status_payload(), indent=2)
-                            + "\n").encode()
-                    ctype = "application/json"
-                else:
-                    self.send_error(404, "unknown path "
-                                    "(try /metrics or /status)")
-                    return
-                self.send_response(200)
+            def _respond(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _dispatch(self, method: str, body: bytes) -> None:
+                if method == "GET" and self.path == "/metrics":
+                    self._respond(
+                        200, "text/plain; version=0.0.4; charset=utf-8",
+                        metrics.to_prometheus().encode())
+                    return
+                if method == "GET" and self.path == "/status":
+                    self._respond(
+                        200, "application/json",
+                        (json.dumps(server.status_payload(), indent=2)
+                         + "\n").encode())
+                    return
+                route = server._route_for(self.path)
+                if route is None:
+                    known = sorted({"/metrics", "/status",
+                                    *server._routes})
+                    self.send_error(404, "unknown path "
+                                    f"(try {', '.join(known)})")
+                    return
+                code, ctype, out = route(method, self.path, body)
+                self._respond(code, ctype, out)
+
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                self._dispatch("GET", b"")
+
+            def do_POST(self) -> None:  # noqa: N802 (http.server API)
+                n = int(self.headers.get("Content-Length") or 0)
+                self._dispatch("POST", self.rfile.read(n) if n else b"")
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 pass  # scrapes must not spam the run's stderr
@@ -111,6 +178,12 @@ class MetricsServer:
         return Handler
 
     def close(self) -> None:
+        """Shut down and JOIN the server thread (graceful shutdown:
+        in-flight responses finish, the socket closes, and the daemon
+        thread is reaped before the caller proceeds). Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)

@@ -1,0 +1,17 @@
+"""Sweepd — the persistent multi-tenant simulation service.
+
+    python -m consensus_tpu.service --port P --state-dir DIR
+
+Assembles the bricks the ROADMAP's sweep-as-a-service item named: the
+PR 11 live endpoints (obs/serve.py, here grown a /jobs API), the PR 12
+grouped-sweep resume and knob-batched dispatch (the compatibility
+batcher's two sharing seams), and the PR 1/2 supervised retry with
+structured RunReports (the solo execution path). See docs/SERVICE.md.
+"""
+from .batcher import Batch, ExecutableCache, knob_key, plan, sweep_key
+from .daemon import SweepService
+from .jobs import JOB_REPORT_FIELDS, Job, JobQueue, job_report_row
+
+__all__ = ["Batch", "ExecutableCache", "Job", "JobQueue",
+           "JOB_REPORT_FIELDS", "SweepService", "job_report_row",
+           "knob_key", "plan", "sweep_key"]

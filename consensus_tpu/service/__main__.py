@@ -1,0 +1,111 @@
+"""Daemon entry point: ``python -m consensus_tpu.service``.
+
+    python -m consensus_tpu.service --port 8787 --state-dir sweepd-state
+    python -m consensus_tpu.service --port 0 --port-file /tmp/port \\
+        --platform cpu            # ephemeral port, script-discoverable
+
+Runs until SIGTERM/SIGINT, then shuts down gracefully (the current
+batch finishes within the close budget; anything still running
+re-admits on the next start — docs/SERVICE.md §"Durability"). Submit
+jobs with ``python -m consensus_tpu ... --submit http://127.0.0.1:P``
+or a plain ``curl -X POST .../jobs``.
+"""
+from __future__ import annotations
+
+import argparse
+import pathlib
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m consensus_tpu.service",
+        description="Sweepd: persistent multi-tenant simulation service "
+                    "(docs/SERVICE.md).")
+    ap.add_argument("--port", type=int, default=0,
+                    help="HTTP port on 127.0.0.1 (0 = ephemeral; the "
+                         "bound port is printed and, with --port-file, "
+                         "written to disk)")
+    ap.add_argument("--state-dir", default="sweepd-state",
+                    help="durable state root: the atomic job journal "
+                         "plus per-job/per-batch snapshot directories — "
+                         "restart with the same dir to re-admit and "
+                         "resume")
+    ap.add_argument("--platform", default="auto",
+                    choices=["auto", "cpu", "tpu", "tpu-trust"],
+                    help="JAX backend selection, same semantics as the "
+                         "CLI's --platform (auto probes hang-proof and "
+                         "falls back to CPU)")
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--retries", type=int, default=1,
+                    help="bounded transient-failure retries per job "
+                         "batch (solo jobs run fully supervised; "
+                         "resume comes from the job's own snapshots)")
+    ap.add_argument("--batch-window", type=float, default=0.25,
+                    metavar="S",
+                    help="admission window in seconds: the worker waits "
+                         "for the queue to go quiet this long before "
+                         "planning, so co-arriving compatible tenants "
+                         "coalesce into one batch (capped at 10 windows "
+                         "under a steady stream; 0 = plan immediately)")
+    ap.add_argument("--publish", default="",
+                    help="also mirror completed-job report rows to this "
+                         "path (e.g. benchmarks/parts/service_jobs.json "
+                         "— the artifact `make ledger` folds into "
+                         "benchmarks/LEDGER.json)")
+    ap.add_argument("--port-file", default="",
+                    help="write the bound port here once listening "
+                         "(ephemeral-port discovery for scripts/CI)")
+    args = ap.parse_args(argv)
+    if not 0 <= args.port <= 65535:
+        ap.error(f"--port must be in [0, 65535] (0 = ephemeral), "
+                 f"got {args.port}")
+    if args.retries < 0:
+        ap.error(f"--retries must be >= 0, got {args.retries}")
+
+    if args.platform == "tpu-trust":
+        tag = "tpu-trust"  # no probe; init may hang if the tunnel is down
+    else:
+        from ..utils.platform import ensure_platform
+        tag = ensure_platform(args.platform,
+                              probe_timeout=args.probe_timeout)
+
+    from ..obs.serve import PortInUseError
+    from .daemon import SweepService
+    try:
+        svc = SweepService(args.state_dir, port=args.port, platform=tag,
+                           retries=args.retries,
+                           batch_window_s=args.batch_window,
+                           publish=args.publish or None)
+    except PortInUseError as exc:
+        print(f"sweepd: {exc}", file=sys.stderr, flush=True)
+        return 2
+    print(f"sweepd: listening on http://127.0.0.1:{svc.port} "
+          f"(/jobs, /metrics, /status; state: {args.state_dir})",
+          file=sys.stderr, flush=True)
+    if args.port_file:
+        pf = pathlib.Path(args.port_file)
+        tmp = pf.with_suffix(pf.suffix + ".tmp")
+        tmp.write_text(str(svc.port))
+        tmp.replace(pf)
+
+    stop = threading.Event()
+
+    def _sig(signum, frame) -> None:
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop.is_set():
+            stop.wait(0.2)
+    finally:
+        svc.close()
+    print("sweepd: shut down cleanly", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Compatibility batcher: which queued jobs can share one XLA program.
+
+The multi-tenant throughput story (ROADMAP "heavy traffic") rests on
+two proven seams of the runner:
+
+  * **the sweep axis** — per-sweep trajectories are pure functions of
+    the per-sweep seed (docs/SPEC.md §1; tests/test_runner.py pins that
+    grouping/slicing the sweep axis never changes any sweep), so jobs
+    whose configs agree on EVERYTHING but ``(seed, n_sweeps)`` can run
+    as one batched program over the concatenated seed vectors — one
+    compile, one dispatch per chunk, for the whole batch;
+  * **traced knob lanes** — jobs that additionally differ only in
+    adversary knob VALUES (the ``core.knobs.KNOB_COLUMNS`` cutoffs)
+    share one compiled program through ``runner.run_knob_batch``: the
+    cutoffs are operands, not constants, so the lanes vmap (PR 12's
+    generation dispatch, bit-identical to per-config runs).
+
+Everything else runs solo — but still through the **executable cache**:
+solo/merged runs are dispatched under a seed-NORMALIZED config
+(``seed=0`` + the explicit per-sweep seed vector), so two tenants
+submitting the same shape with different seeds hash to the SAME static
+jit argument and the second never recompiles. The cache key is the
+hlocheck-style identity: the full normalized config JSON (every field
+that selects the compiled program) — what tools/hlocheck registers a
+target by, minus the trajectory-only seed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from ..core.config import Config
+from .jobs import job_order
+
+# Config fields that select TRAJECTORIES, not the compiled program or
+# the protocol semantics: jobs differing only here merge onto the
+# sweep axis.
+SWEEP_AXIS_FIELDS = frozenset({"seed", "n_sweeps"})
+
+# The adversary knob VALUES `runner.run_knob_batch` traces as operands
+# (core/knobs.KNOB_COLUMNS, in Config-field terms). Jobs differing only
+# here (and on the sweep axis) share one knob-batched program —
+# PROVIDED the static gates agree (a gated-off adversary is untraced;
+# see knob_key).
+KNOB_VALUE_FIELDS = frozenset({
+    "drop_rate", "partition_rate", "churn_rate", "crash_prob",
+    "recover_prob", "miss_rate", "attack_rate", "attack_target",
+})
+
+
+def _identity(cfg: Config, *, minus: frozenset) -> tuple:
+    d = json.loads(cfg.to_json())
+    d.pop("_cutoffs", None)
+    return tuple(sorted((k, json.dumps(v)) for k, v in d.items()
+                        if k not in minus))
+
+
+def sweep_key(job) -> tuple | None:
+    """Sweep-axis compatibility key, or None when the job cannot merge:
+    only plain tpu-engine jobs qualify (a scenario job's overrides and
+    verdict are its own; the cpu oracle loops sweeps host-side; a
+    sweep_chunk/mesh request asks for its own execution geometry, which
+    the solo path honors via the per-job --group-dir layout)."""
+    cfg = job.cfg()
+    if (job.scenario or cfg.engine != "tpu" or cfg.sweep_chunk
+            or cfg.mesh_shape):
+        return None
+    return ("sweep",) + _identity(cfg, minus=SWEEP_AXIS_FIELDS)
+
+
+def knob_key(job) -> tuple | None:
+    """Knob-lane compatibility key, or None. On top of the sweep-axis
+    conditions this requires the flight recorder (run_knob_batch reads
+    fitness off it — and more to the point its lane program always
+    records it, so recorder-off jobs would pay for series they never
+    asked for) and encodes the static adversary GATES: crash/miss/
+    partition on-ness and the attack kind select WHAT is traced, so
+    lanes can only share a program when they agree on them."""
+    cfg = job.cfg()
+    if sweep_key(job) is None or cfg.telemetry_window <= 0:
+        return None
+    gates = ("gates", cfg.crash_on, cfg.miss_on, cfg.no_partition,
+             cfg.attack)
+    return ("knob", gates) + _identity(
+        cfg, minus=SWEEP_AXIS_FIELDS | KNOB_VALUE_FIELDS)
+
+
+@dataclasses.dataclass(frozen=True)
+class Batch:
+    """One schedulable unit: ``kind`` is "merged" (sweep-axis batch,
+    one runner.run), "knobs" (one run_knob_batch dispatch), or "solo"
+    (one job through the simulator front door)."""
+    kind: str
+    jobs: tuple
+
+
+def plan(jobs: list) -> list[Batch]:
+    """Group queued jobs (submit order preserved within and across
+    groups) into shared-program batches. Deterministic in the job list
+    — a restarted daemon re-forms the same plan from the re-admitted
+    journal, which is what lets a merged batch find its own snapshots
+    again (jobs.JobQueue.batch_dir)."""
+    sweep_groups: dict[tuple, list] = {}
+    rest: list = []
+    for job in jobs:
+        key = sweep_key(job)
+        if key is None:
+            rest.append(job)
+        else:
+            sweep_groups.setdefault(key, []).append(job)
+
+    batches: list[Batch] = []
+    singles: list = []
+    for group in sweep_groups.values():
+        if len(group) > 1:
+            batches.append(Batch("merged", tuple(group)))
+        else:
+            singles.extend(group)
+
+    knob_groups: dict[tuple, list] = {}
+    for job in singles:
+        key = knob_key(job)
+        if key is None:
+            rest.append(job)
+        else:
+            knob_groups.setdefault(key, []).append(job)
+    for group in knob_groups.values():
+        if len(group) > 1:
+            batches.append(Batch("knobs", tuple(group)))
+        else:
+            rest.extend(group)
+
+    batches.extend(Batch("solo", (job,)) for job in rest)
+    # Schedule in submit order of each batch's FIRST member, so one
+    # tenant's late incompatible job never starves an earlier one
+    # (numeric id order — the counter outlives the zero padding).
+    batches.sort(key=lambda b: job_order(b.jobs[0].id))
+    return batches
+
+
+def effective_seeds(job) -> np.ndarray:
+    """The job's per-sweep u32 seed vector: the explicit one when
+    submitted, else SPEC §1 lo32(seed + b) — computed HERE (not on
+    device) so merged batches can concatenate before normalizing the
+    config's seed away."""
+    if job.seeds is not None:
+        return np.asarray(job.seeds, dtype=np.uint32)
+    cfg = job.cfg()
+    return ((np.uint64(cfg.seed)
+             + np.arange(cfg.n_sweeps, dtype=np.uint64))
+            & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def normalized(cfg: Config, n_sweeps: int) -> Config:
+    """The dispatch form of a (possibly merged) config: ``seed=0`` —
+    trajectories come from the explicit seed vector, so the seed field
+    must not fragment the jit cache — and the batch's total sweep
+    count. THIS value is the executable-cache identity: equal
+    normalized configs are equal static jit arguments, and jax
+    guarantees the second dispatch reuses the compiled program."""
+    return dataclasses.replace(cfg, seed=0, n_sweeps=n_sweeps)
+
+
+class ExecutableCache:
+    """Process-lifetime bookkeeping of which compiled-program shapes
+    this service has already paid for. The cache that actually holds
+    the executables is jax's jit cache (keyed by the same normalized
+    config, by construction — see :func:`normalized`); this records
+    hits/misses so tenants and tests can SEE the reuse
+    (``service_exec_cache_hits_total``, the /jobs/<id> ``cache_hit``
+    field)."""
+
+    def __init__(self) -> None:
+        self._seen: set[tuple] = set()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(kind: str, cfg: Config) -> tuple:
+        return (kind,) + _identity(cfg, minus=frozenset({"seed"}))
+
+    def admit(self, key: tuple) -> bool:
+        """Record one execution under ``key``; returns True when the
+        shape was seen before (the dispatch reuses the executable)."""
+        hit = key in self._seen
+        self._seen.add(key)
+        self.hits += int(hit)
+        self.misses += int(not hit)
+        return hit
+
+
+def lane_matrix(cfgs: list[Config], sizes: list[int]) -> np.ndarray:
+    """The run_knob_batch kmat for a knob batch: each job's cutoff row
+    repeated once per sweep, in KNOB_COLUMNS order."""
+    from ..core import knobs as knobslib
+    rows = []
+    for cfg, size in zip(cfgs, sizes):
+        row = [int(getattr(cfg, name)) for name in knobslib.KNOB_COLUMNS]
+        rows.extend([row] * size)
+    return np.asarray(rows, dtype=np.uint32)

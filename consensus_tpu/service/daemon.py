@@ -1,0 +1,446 @@
+"""Sweepd: the persistent multi-tenant simulation service.
+
+    python -m consensus_tpu.service --port P --state-dir DIR
+
+One long-lived process accepts queued sweep jobs over a local HTTP API
+(mounted on the PR 11 introspection server, obs/serve.py), schedules
+them through the existing runner, and survives restarts:
+
+  * **throughput** — the compatibility batcher (service/batcher.py)
+    merges tenants sharing a (protocol, static shape) onto the sweep
+    axis of ONE compiled program, runs knob-only-differing tenants as
+    traced lanes of one ``run_knob_batch`` dispatch, and never
+    recompiles a repeat shape (seed-normalized configs hit jax's jit
+    cache; the hit is witnessed by ``service_exec_cache_hits_total``);
+  * **availability** — the durable queue (service/jobs.py) journals
+    every transition atomically, each solo job checkpoints into its own
+    ``<state_dir>/jobs/<id>/`` rotation set (the ``--group-dir`` layout
+    when the job asks for sweep grouping) and each merged batch into
+    ``<state_dir>/batches/<ids>/``, so a SIGKILLed daemon restarts,
+    re-admits queued jobs and resumes in-flight ones from their
+    snapshots with bit-identical results (the PR 1/4/12 resume
+    contract);
+  * **observability** — /jobs (submit + list), /jobs/<id> (status,
+    live ``rounds_completed``/ETA off per-job labeled gauges, digest,
+    RunReport, scenario verdict), /metrics (the process registry incl.
+    the per-job gauge families), /status (fleet counts); completed-job
+    report rows fold into ``benchmarks/LEDGER.json`` via
+    ``tools/ledger.py`` when published.
+
+Execution is ONE worker thread: jax dispatch wants a single driver, and
+the batcher — not thread-count — is the concurrency story (tenants
+share programs, not cores). HTTP handlers only touch the journal and
+the metrics registry.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import serve as obs_serve
+from ..obs import trace as obs_trace
+from . import batcher
+from .jobs import Job, JobQueue, job_order
+
+_JSON = "application/json"
+
+
+def _body(doc: Any) -> bytes:
+    return (json.dumps(doc, indent=2) + "\n").encode()
+
+
+class SweepService:
+    """The daemon object: queue + batcher + worker + HTTP front door.
+    Usable in-process (tests construct it directly) or via
+    ``python -m consensus_tpu.service`` (one per machine/state-dir).
+    """
+
+    def __init__(self, state_dir, *, port: int = 0, platform: str = "cpu",
+                 retries: int = 1, publish=None,
+                 poll_s: float = 0.05,
+                 batch_window_s: float = 0.25) -> None:
+        self.queue = JobQueue(state_dir)
+        self.cache = batcher.ExecutableCache()
+        self.platform = platform
+        self.retries = int(retries)
+        self.publish = publish
+        self._poll_s = poll_s
+        # Admission window: after a submission the worker waits for the
+        # queue to go quiet this long before planning, so co-arriving
+        # compatible tenants COALESCE into one batch instead of the
+        # first one racing into a solo run. Capped (see _settle) so a
+        # steady submission stream can never starve execution. 0 = plan
+        # immediately (tests that pre-populate the journal).
+        self.batch_window_s = batch_window_s
+        self._last_submit = 0.0
+        self._t0 = time.time()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._closed = False
+        if self.queue.readmitted:
+            obs_metrics.counter("service_jobs_readmitted_total").inc(
+                len(self.queue.readmitted))
+        self._gauge_depth()
+        # The HTTP front door rides the PR 11 introspection server —
+        # same shutdown path, same PortInUseError policy.
+        self._server = obs_serve.MetricsServer(
+            port, status=self._status,
+            routes={"/jobs": self._route_jobs})
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        name="sweepd-worker", daemon=True)
+        self._worker.start()
+
+    # --- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def close(self, wait_s: float = 30.0) -> None:
+        """Graceful shutdown: stop admitting work, let the worker
+        finish (bounded wait — an overrunning batch's jobs stay
+        ``running`` in the journal and re-admit on the next start),
+        close+join the HTTP thread, flush the report artifact.
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._wake.set()
+        self._worker.join(timeout=wait_s)
+        self._server.close()
+        self._write_reports()
+
+    def __enter__(self) -> "SweepService":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def wait_idle(self, timeout_s: float = 120.0) -> bool:
+        """Block until no job is queued or running (tests/smokes)."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            c = self.queue.counts()
+            if not c["queued"] and not c["running"]:
+                return True
+            time.sleep(0.02)
+        return False
+
+    # --- HTTP ---------------------------------------------------------------
+
+    def _status(self) -> dict[str, Any]:
+        return {"service": "sweepd", "pid": os.getpid(),
+                "platform": self.platform,
+                "state_dir": str(self.queue.path.parent),
+                "jobs": self.queue.counts(),
+                "executable_cache": {"hits": self.cache.hits,
+                                     "misses": self.cache.misses}}
+
+    def _job_doc(self, job: Job) -> dict[str, Any]:
+        doc = job.to_dict()
+        if job.status == "running":
+            for field, gname in (("rounds_completed",
+                                  "service_job_rounds_completed"),
+                                 ("eta_s", "service_job_eta_s")):
+                v = obs_metrics.labeled_gauge(gname).get(job=job.id)
+                if v is not None:
+                    doc[field] = v
+        return doc
+
+    def _route_jobs(self, method: str, path: str,
+                    body: bytes) -> tuple[int, str, bytes]:
+        try:
+            if path == "/jobs" and method == "POST":
+                return self._submit(body)
+            if path == "/jobs" and method == "GET":
+                rows = [{"id": j.id, "name": j.name, "status": j.status,
+                         "protocol": j.config.get("protocol"),
+                         "n_sweeps": (len(j.seeds) if j.seeds
+                                      else j.config.get("n_sweeps")),
+                         "batch": j.batch,
+                         "digest": (j.result or {}).get("digest")}
+                        for j in sorted(self.queue.jobs(),
+                                        key=lambda j: job_order(j.id))]
+                return 200, _JSON, _body({"jobs": rows})
+            if path.startswith("/jobs/") and method == "GET":
+                job = self.queue.get(path[len("/jobs/"):])
+                if job is None:
+                    return 404, _JSON, _body({"error": "unknown job id"})
+                return 200, _JSON, _body(self._job_doc(job))
+            return 405, _JSON, _body({"error": f"{method} {path} is not "
+                                      "part of the /jobs API"})
+        except (ValueError, KeyError) as exc:
+            # Admission-time validation failures (Config/seeds/scenario)
+            # are the CLIENT's 400, never a worker crash later.
+            return 400, _JSON, _body({"error": str(exc)})
+
+    def _submit(self, body: bytes) -> tuple[int, str, bytes]:
+        try:
+            doc = json.loads(body.decode() or "{}")
+        except ValueError:
+            return 400, _JSON, _body({"error": "request body must be "
+                                      "JSON ({'config': {...}, ...})"})
+        if not isinstance(doc, dict) or not isinstance(doc.get("config"),
+                                                       dict):
+            return 400, _JSON, _body({"error": "missing 'config' object "
+                                      "(a Config JSON, docs/SERVICE.md)"})
+        job = self.queue.submit(doc["config"], name=doc.get("name"),
+                                seeds=doc.get("seeds"),
+                                scenario=doc.get("scenario"))
+        obs_metrics.counter("service_jobs_submitted_total").inc()
+        self._last_submit = time.monotonic()
+        self._gauge_depth()
+        self._wake.set()
+        return 200, _JSON, _body({"id": job.id, "status": job.status,
+                                  "name": job.name})
+
+    # --- worker -------------------------------------------------------------
+
+    def _gauge_depth(self) -> None:
+        obs_metrics.gauge("service_queue_depth").set(
+            self.queue.counts()["queued"])
+
+    def _settle(self) -> None:
+        """Wait out the admission window: until no submission landed
+        for ``batch_window_s`` — or 10 windows total, whichever comes
+        first (a steady stream must not starve the jobs already
+        queued)."""
+        if self.batch_window_s <= 0:
+            return
+        deadline = time.monotonic() + 10 * self.batch_window_s
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            quiet = time.monotonic() - self._last_submit
+            if quiet >= self.batch_window_s:
+                return
+            time.sleep(min(self.batch_window_s - quiet, 0.05))
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            queued = self.queue.queued()
+            if not queued:
+                self._wake.wait(self._poll_s)
+                self._wake.clear()
+                continue
+            self._settle()
+            queued = self.queue.queued()  # re-snapshot after the window
+            batch = batcher.plan(queued)[0]
+            now = time.time()
+            ids = [j.id for j in batch.jobs]
+            for j in batch.jobs:
+                j.status = "running"
+                j.started_unix = now
+                j.batch = ids if len(ids) > 1 else None
+            self.queue.update(*batch.jobs)
+            self._gauge_depth()
+            try:
+                with obs_trace.span("service_batch", kind=batch.kind,
+                                    n_jobs=len(batch.jobs)):
+                    if batch.kind == "merged":
+                        self._execute_merged(list(batch.jobs))
+                    elif batch.kind == "knobs":
+                        self._execute_knobs(list(batch.jobs))
+                    else:
+                        self._execute_solo(batch.jobs[0])
+                obs_metrics.counter("service_batches_total").inc()
+                obs_metrics.counter("service_jobs_completed_total").inc(
+                    len(batch.jobs))
+            except Exception as exc:  # noqa: BLE001 — job-scoped failure
+                now = time.time()
+                for j in batch.jobs:
+                    j.status = "failed"
+                    j.error = repr(exc)
+                    j.finished_unix = now
+                self.queue.update(*batch.jobs)
+                obs_metrics.counter("service_jobs_failed_total").inc(
+                    len(batch.jobs))
+            finally:
+                # Both per-job families stay bounded on a long-lived
+                # daemon: finished jobs' live numbers move into the
+                # durable job doc, so the children can go.
+                for j in batch.jobs:
+                    for gname in ("service_job_eta_s",
+                                  "service_job_rounds_completed"):
+                        obs_metrics.labeled_gauge(gname).remove(job=j.id)
+            self._write_reports()
+
+    def _write_reports(self) -> None:
+        self.queue.write_reports(
+            self.queue.path.parent / "job_reports.json", self.platform)
+        if self.publish:
+            self.queue.write_reports(self.publish, self.platform)
+
+    def _retrying(self, fn):
+        """Bounded transient-failure retry around a dispatch (merged /
+        knob batches drive the runner directly; solo jobs get the full
+        supervisor instead). Resume comes from the batch's own
+        checkpoints, so a retry costs one chunk, not the batch."""
+        from ..network import supervisor
+        last: BaseException | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                return fn()
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not supervisor.is_transient(exc) \
+                        or attempt >= self.retries:
+                    raise
+                last = exc
+                obs_trace.event("attempt_failed", index=attempt,
+                                error=repr(exc))
+                time.sleep(min(2.0, 0.2 * (2 ** attempt)))
+        raise last  # unreachable; keeps the type checker honest
+
+    def _progress_cb(self, job_ids: list[str]):
+        rg = obs_metrics.labeled_gauge("service_job_rounds_completed")
+        eg = obs_metrics.labeled_gauge("service_job_eta_s")
+
+        def cb(info: dict) -> None:
+            for jid in job_ids:
+                rg.set(info["round"], job=jid)
+                eg.set(round(info["eta_s"], 3), job=jid)
+        return cb
+
+    # --- execution paths ----------------------------------------------------
+
+    def _finish(self, job: Job, cfg, *, payload: bytes, wall: float,
+                steps: int, extras: dict | None = None) -> None:
+        """``steps`` must count the rounds the execution ACTUALLY ran
+        (a resumed run skipped its checkpointed prefix) — the row feeds
+        the perf ledger, and full-run steps over a resumed wall clock
+        would fake a throughput gain."""
+        from ..core import serialize
+        job.result = {
+            "digest": serialize.digest(payload),
+            "payload_bytes": len(payload),
+            "wall_s": round(wall, 6), "steps": steps,
+            "steps_per_sec": round(steps / wall, 1) if wall > 0 else 0.0,
+            **(extras or {})}
+        job.status = "done"
+        job.finished_unix = time.time()
+
+    def _execute_merged(self, jobs: list[Job]) -> None:
+        """Sweep-axis batch: one runner.run over the concatenated seed
+        vectors — every dispatch span covers the WHOLE batch (the
+        acceptance witness that concurrent tenants share one compiled
+        program), one checkpoint rotation set per batch."""
+        from ..network import runner, simulator
+        cfgs = [j.cfg() for j in jobs]
+        seed_vecs = [batcher.effective_seeds(j) for j in jobs]
+        sizes = [len(s) for s in seed_vecs]
+        seeds = np.concatenate(seed_vecs)
+        cfg_run = batcher.normalized(cfgs[0], int(seeds.shape[0]))
+        hit = self.cache.admit(batcher.ExecutableCache.key("run", cfg_run))
+        self._account_cache(jobs, hit)
+        eng = simulator.engine_def(cfg_run)
+        ckpt = self.queue.batch_dir([j.id for j in jobs]) / "ck.npz"
+        stats: dict = {}
+        t0 = time.perf_counter()
+        out = self._retrying(lambda: runner.run(
+            cfg_run, eng, seeds=seeds, stats=stats,
+            checkpoint_path=str(ckpt), resume=True, final_checkpoint=True,
+            telemetry=cfg_run.telemetry_window > 0,
+            progress=self._progress_cb([j.id for j in jobs])))
+        wall = time.perf_counter() - t0
+        executed = stats.get("executed_rounds", cfg_run.n_rounds)
+        start = stats.get("start_round", 0)
+        off = 0
+        for job, cfg, size in zip(jobs, cfgs, sizes):
+            sub = {k: v[off:off + size] for k, v in out.items()}
+            off += size
+            *_, payload = simulator.decided_payload(cfg, sub)
+            self._finish(job, cfg, payload=payload, wall=wall,
+                         steps=size * cfg.n_nodes * executed,
+                         extras={"resumed_from_round": start})
+        self.queue.update(*jobs)
+
+    def _execute_knobs(self, jobs: list[Job]) -> None:
+        """Knob-lane batch: tenants differing only in adversary knob
+        values run as traced lanes of ONE run_knob_batch dispatch
+        (PR 12's generation program; lanes bit-identical to per-config
+        runs). No checkpoint surface — a restart recomputes the batch
+        deterministically."""
+        from ..network import runner, simulator
+        cfgs = [j.cfg() for j in jobs]
+        seed_vecs = [batcher.effective_seeds(j) for j in jobs]
+        sizes = [len(s) for s in seed_vecs]
+        seeds = np.concatenate(seed_vecs)
+        base = batcher.normalized(cfgs[0], int(seeds.shape[0]))
+        hit = self.cache.admit(batcher.ExecutableCache.key("knob", base))
+        self._account_cache(jobs, hit)
+        eng = simulator.engine_def(base)
+        kmat = batcher.lane_matrix(cfgs, sizes)
+        t0 = time.perf_counter()
+        out, _flight = self._retrying(
+            lambda: runner.run_knob_batch(base, eng, seeds, kmat))
+        wall = time.perf_counter() - t0
+        off = 0
+        for job, cfg, size in zip(jobs, cfgs, sizes):
+            sub = {k: v[off:off + size] for k, v in out.items()}
+            off += size
+            *_, payload = simulator.decided_payload(cfg, sub)
+            self._finish(job, cfg, payload=payload, wall=wall,
+                         steps=size * cfg.n_nodes * cfg.n_rounds)
+        self.queue.update(*jobs)
+
+    def _execute_solo(self, job: Job) -> None:
+        """One job through the supervised front door: bounded retry +
+        resume from the job's OWN snapshot directory (the --group-dir
+        layout when the job asks for sweep grouping), the structured
+        RunReport in the job doc, scenario verdicts evaluated exactly
+        like the CLI's --scenario."""
+        from ..network import supervisor
+        cfg = job.cfg()
+        sdef = None
+        if job.scenario:
+            from .. import scenarios
+            sdef = scenarios.get(job.scenario)
+            cfg = scenarios.apply(cfg, sdef)
+        kw: dict[str, Any] = {}
+        if cfg.engine == "tpu":
+            seeds = (batcher.effective_seeds(job) if job.scenario is None
+                     else None)
+            if seeds is not None:
+                # Seed-normalized dispatch: the executable cache's whole
+                # mechanism (same static config value == jit cache hit).
+                norm = batcher.normalized(cfg, cfg.n_sweeps)
+                hit = self.cache.admit(
+                    batcher.ExecutableCache.key("run", norm))
+                self._account_cache([job], hit)
+                cfg = norm
+                kw["seeds"] = seeds
+            jobdir = self.queue.job_dir(job.id)
+            if cfg.sweep_chunk and cfg.sweep_chunk < cfg.n_sweeps:
+                kw["group_dir"] = str(jobdir / "groups")
+            else:
+                kw["checkpoint_path"] = str(jobdir / "ck.npz")
+            kw["telemetry"] = cfg.telemetry_window > 0
+            kw["progress"] = self._progress_cb([job.id])
+        t0 = time.perf_counter()
+        result = supervisor.supervised_run(cfg, retries=self.retries, **kw)
+        wall = time.perf_counter() - t0
+        extras: dict[str, Any] = {}
+        rr = result.extras.get("run_report")
+        if rr is not None:
+            extras["run_report"] = rr
+            extras["resumed_from_round"] = rr["resumed_from_round"]
+        if sdef is not None:
+            from .. import scenarios
+            extras["scenario"] = scenarios.evaluate(sdef, result)
+        # node_round_steps already counts only the rounds this
+        # execution ran (a resumed attempt skips its prefix).
+        self._finish(job, cfg, payload=result.payload, wall=wall,
+                     steps=result.node_round_steps, extras=extras)
+        self.queue.update(job)
+
+    def _account_cache(self, jobs: list[Job], hit: bool) -> None:
+        for j in jobs:
+            j.cache_hit = hit
+        if hit:
+            obs_metrics.counter("service_exec_cache_hits_total").inc()

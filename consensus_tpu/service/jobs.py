@@ -1,0 +1,282 @@
+"""Durable job queue for the sweep service (docs/SERVICE.md §"Jobs").
+
+One job = one tenant's sweep request: a full :class:`Config` JSON (the
+seed range is the SPEC §1 ``(seed, n_sweeps)`` pair, or an explicit
+``seeds`` vector), an optional scripted scenario, and a display name.
+The queue is DURABLE: every transition rewrites ``<state_dir>/
+queue.json`` atomically (tmp + rename, the checkpoint-manifest
+discipline from network/runner.py), so a SIGKILLed daemon restarts
+with the exact queue it died with — jobs it never started are
+re-admitted as queued, jobs it was executing revert to queued and
+resume from their own snapshots under ``<state_dir>/jobs/<id>/``
+(bit-identical by the checkpoint layer's contract).
+
+The completed-job report row (:data:`JOB_REPORT_FIELDS`, exactly these
+keys) is the artifact ``tools/ledger.py`` folds into
+``benchmarks/LEDGER.json`` as ``service-job`` rows; the field tuple is
+mirrored import-free in ``tools/validate_trace.py``
+(``SERVICE_JOB_FIELDS``) and lint-synced both ways like the telemetry
+counter registry (tools/lint/registry_sync.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import pathlib
+import threading
+import time
+from typing import Any
+
+from ..core.config import Config
+
+QUEUE_VERSION = 1
+JOB_STATES = ("queued", "running", "done", "failed")
+
+# One completed-job report row = exactly these keys (nulls where a job
+# has no value). Mirrored import-free in tools/validate_trace.py
+# (SERVICE_JOB_FIELDS) and lint-synced both ways.
+JOB_REPORT_FIELDS = ("schema", "id", "name", "protocol", "engine",
+                     "platform", "n_nodes", "n_rounds", "n_sweeps",
+                     "submitted_unix", "finished_unix", "wall_s", "steps",
+                     "steps_per_sec", "digest", "status", "batch",
+                     "cache_hit", "scenario_passed", "error")
+JOB_REPORT_SCHEMA = 1
+
+
+def job_order(job_id: str) -> tuple:
+    """Submit-order sort key for a job id: NUMERIC on the counter part,
+    because a persistent state-dir outlives the zero padding
+    ('j10000' must sort after 'j9999', not between 'j0999' and
+    'j2000' — the batcher's anti-starvation ordering and the /jobs
+    listing both rest on this)."""
+    digits = job_id.lstrip("j")
+    return (0, int(digits)) if digits.isdigit() else (1, job_id)
+
+
+@dataclasses.dataclass
+class Job:
+    """One queued sweep request plus everything the service learned
+    about it. ``config`` stays the submitted JSON dict (the durable
+    form); :meth:`cfg` revalidates it through the one Config schema."""
+    id: str
+    name: str
+    config: dict
+    status: str = "queued"
+    seeds: list | None = None          # explicit per-sweep seed vector
+    scenario: str | None = None        # scripted-attack name, applied at run
+    submitted_unix: float = 0.0
+    started_unix: float | None = None
+    finished_unix: float | None = None
+    batch: list | None = None          # job ids sharing the compiled program
+    cache_hit: bool = False            # executable-shape seen before?
+    readmissions: int = 0              # times re-admitted after a restart
+    result: dict | None = None         # digest/wall/steps/... once done
+    error: str | None = None
+
+    def cfg(self) -> Config:
+        return Config.from_json(json.dumps(self.config))
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def job_report_row(job: Job, platform: str) -> dict[str, Any]:
+    """The completed-job ledger row (exactly :data:`JOB_REPORT_FIELDS`
+    keys) for a done/failed job."""
+    res = job.result or {}
+    row = {k: None for k in JOB_REPORT_FIELDS}
+    row.update(
+        schema=JOB_REPORT_SCHEMA, id=job.id, name=job.name,
+        protocol=job.config.get("protocol"),
+        engine=job.config.get("engine"), platform=platform,
+        n_nodes=job.config.get("n_nodes"),
+        n_rounds=job.config.get("n_rounds"),
+        n_sweeps=(len(job.seeds) if job.seeds
+                  else job.config.get("n_sweeps")),
+        submitted_unix=job.submitted_unix,
+        finished_unix=job.finished_unix,
+        wall_s=res.get("wall_s"), steps=res.get("steps"),
+        steps_per_sec=res.get("steps_per_sec"),
+        digest=res.get("digest"), status=job.status, batch=job.batch,
+        cache_hit=job.cache_hit,
+        scenario_passed=(res.get("scenario") or {}).get("passed"),
+        error=job.error)
+    assert set(row) == set(JOB_REPORT_FIELDS), \
+        f"job report keys drifted: {sorted(row)}"
+    return row
+
+
+class JobQueue:
+    """The durable queue: an atomic JSON journal plus per-job snapshot
+    directories. Thread-safe (the HTTP handlers submit while the worker
+    transitions); every mutation is persisted before it is visible."""
+
+    def __init__(self, state_dir) -> None:
+        self._dir = pathlib.Path(state_dir)
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._jobs: dict[str, Job] = {}
+        self._next = 1
+        self.readmitted: list[str] = []
+        self._load()
+
+    # --- journal ------------------------------------------------------------
+
+    @property
+    def path(self) -> pathlib.Path:
+        return self._dir / "queue.json"
+
+    def job_dir(self, job_id: str) -> pathlib.Path:
+        """The job's own snapshot directory (``--checkpoint`` rotation
+        set, or the ``--group-dir`` layout for sweep-grouped jobs)."""
+        return self._dir / "jobs" / job_id
+
+    def batch_dir(self, job_ids: list[str]) -> pathlib.Path:
+        """Snapshot directory for a MERGED batch: keyed by the member
+        ids, so the deterministically re-formed batch of a restarted
+        daemon finds its own snapshots (a changed membership simply
+        misses — the checkpoint layer's config/seed identity check
+        would refuse the stale snapshot anyway)."""
+        return self._dir / "batches" / "+".join(job_ids)
+
+    def _load(self) -> None:
+        try:
+            doc = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return
+        if doc.get("version") != QUEUE_VERSION:
+            return
+        self._next = int(doc.get("next_id", 1))
+        for jd in doc.get("jobs", []):
+            job = Job(**jd)
+            if job.status == "running":
+                # The previous daemon died mid-execution: its snapshots
+                # (if any) are on disk, so re-admit and let the run
+                # resume from them (or recompute — never wrong results,
+                # the checkpoint layer validates identity).
+                job.status = "queued"
+                job.batch = None
+                job.readmissions += 1
+                self.readmitted.append(job.id)
+            self._jobs[job.id] = job
+        if self.readmitted:
+            self._save_locked()
+
+    def _save_locked(self) -> None:
+        doc = {"version": QUEUE_VERSION, "next_id": self._next,
+               "jobs": [j.to_dict() for j in self._jobs.values()]}
+        tmp = self.path.with_suffix(".tmp.json")
+        tmp.write_text(json.dumps(doc, indent=2))
+        tmp.replace(self.path)
+
+    # --- API ----------------------------------------------------------------
+
+    def submit(self, config: dict, *, name: str | None = None,
+               seeds: list | None = None,
+               scenario: str | None = None) -> Job:
+        """Validate and enqueue one job; returns the persisted record.
+        Raises ValueError on an invalid config / seeds / scenario —
+        admission is the validation boundary, not execution (a bad
+        request must 400 at submit, never fail a worker later)."""
+        cfg = Config.from_json(json.dumps(config))  # validates
+        if seeds is not None:
+            seeds = [int(s) for s in seeds]
+            if len(seeds) != cfg.n_sweeps:
+                raise ValueError(
+                    f"seeds has {len(seeds)} entries but config.n_sweeps "
+                    f"= {cfg.n_sweeps} (the explicit seed vector must "
+                    "cover exactly the sweep axis)")
+        if scenario:
+            if cfg.engine != "tpu":
+                raise ValueError(
+                    "a scenario job needs engine='tpu': the scripted "
+                    "attacks are judged against the flight recorder, "
+                    "which only the TPU engine records (the CLI's "
+                    "--scenario has the same rule)")
+            if seeds is not None:
+                raise ValueError(
+                    "a scenario job cannot carry an explicit seeds "
+                    "vector: the scenario's overrides may reshape the "
+                    "sweep geometry, and a stale vector would silently "
+                    "simulate different trajectories")
+            from .. import scenarios
+            scenarios.get(scenario)  # ValueError -> unknown name
+        if not name:
+            # Default names carry a shape-identity hash (config minus
+            # the trajectory seed): the name keys a LEDGER series, and
+            # two different workloads under one default name would
+            # cross-compare into fake regression verdicts. Same shape
+            # + different seed = same name = one honest series.
+            d = json.loads(cfg.to_json())
+            d.pop("_cutoffs", None)
+            d.pop("seed", None)
+            shape = hashlib.sha256(
+                json.dumps(d, sort_keys=True).encode()).hexdigest()[:6]
+            name = (f"{cfg.protocol}-{cfg.n_nodes}n-{cfg.n_rounds}r-"
+                    f"{shape}")
+        with self._lock:
+            job = Job(id=f"j{self._next:04d}",
+                      name=name,
+                      config=json.loads(cfg.to_json()),
+                      seeds=seeds, scenario=scenario,
+                      submitted_unix=time.time())
+            self._next += 1
+            self._jobs[job.id] = job
+            self._save_locked()
+            return job
+
+    def get(self, job_id: str) -> Job | None:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> list[Job]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def queued(self) -> list[Job]:
+        with self._lock:
+            return [j for j in self._jobs.values() if j.status == "queued"]
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            out = {s: 0 for s in JOB_STATES}
+            for j in self._jobs.values():
+                out[j.status] += 1
+            return out
+
+    def update(self, *jobs: Job) -> None:
+        """Persist one transition for the given (already-mutated) jobs
+        — one atomic journal write covers the whole batch."""
+        with self._lock:
+            for job in jobs:
+                if job.status not in JOB_STATES:
+                    raise ValueError(f"unknown job status {job.status!r}")
+                self._jobs[job.id] = job
+            self._save_locked()
+
+    # --- reports ------------------------------------------------------------
+
+    def finished(self) -> list[Job]:
+        with self._lock:
+            return [j for j in self._jobs.values()
+                    if j.status in ("done", "failed")]
+
+    def report_doc(self, platform: str) -> dict[str, Any]:
+        """All finished jobs as the ledger-ingestable artifact
+        (``{"version": 1, "rows": [JOB_REPORT_FIELDS...]}``)."""
+        return {"version": 1,
+                "rows": [job_report_row(j, platform)
+                         for j in sorted(self.finished(),
+                                         key=lambda j: job_order(j.id))]}
+
+    def write_reports(self, path, platform: str) -> None:
+        """Atomically write (replace) the job-report artifact — the
+        file ``tools/ledger.py`` ingests as ``service-job`` rows when
+        published at ``benchmarks/parts/service_jobs.json``."""
+        path = pathlib.Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp.json")
+        tmp.write_text(json.dumps(self.report_doc(platform), indent=2,
+                                  sort_keys=True) + "\n")
+        tmp.replace(path)

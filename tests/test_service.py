@@ -1,0 +1,708 @@
+"""Sweepd — the persistent multi-tenant simulation service
+(consensus_tpu/service, docs/SERVICE.md).
+
+Layers under test:
+
+  * the durable job queue (atomic journal, validation at admission,
+    running->queued re-admission on restart);
+  * the compatibility batcher (sweep-axis merge, knob lanes, solo
+    fallback, the executable cache);
+  * the end-to-end acceptance contract: two jobs sharing a (protocol,
+    static shape) + one incompatible job — the compatible pair
+    provably shares ONE compiled program (every dispatch span covers
+    the pair; the jit cache does not grow for a repeat shape) and
+    every job's digest is bit-identical to its standalone runner run;
+  * durability: a daemon restarted over an in-flight job's state
+    resumes from the job's own snapshot mid-scan (tier-1, doctored
+    layout) — the real-SIGKILL daemon version lives in the slow tier;
+  * the HTTP API, the per-job labeled gauges, the report artifact +
+    ledger ingestion, and the CLI --submit client mode.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_tpu import cli
+from consensus_tpu.core.config import Config
+from consensus_tpu.network import runner, simulator
+from consensus_tpu.obs import metrics as obs_metrics
+from consensus_tpu.obs import serve as obs_serve
+from consensus_tpu.obs import trace as obs_trace
+from consensus_tpu.service import (JOB_REPORT_FIELDS, JobQueue,
+                                   SweepService, batcher, job_report_row)
+from tools import validate_trace as vt
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+BASE = dict(protocol="raft", engine="tpu", n_nodes=5, n_rounds=64,
+            n_sweeps=2, seed=3, log_capacity=32, max_entries=24)
+OTHER = dict(BASE, protocol="paxos", n_nodes=9, n_rounds=48)
+
+
+def _cfg(d: dict) -> Config:
+    return Config.from_json(json.dumps(d))
+
+
+def _post(url: str, doc: dict) -> dict:
+    req = urllib.request.Request(url + "/jobs",
+                                 data=json.dumps(doc).encode(),
+                                 method="POST")
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+def _get(url: str, path: str) -> dict:
+    return json.loads(
+        urllib.request.urlopen(url + path, timeout=30).read())
+
+
+def _standalone_digest(config: dict) -> str:
+    cfg = _cfg(config)
+    kw = dict(stats={}, telemetry=True) if cfg.telemetry_window > 0 \
+        else {}
+    return simulator.run(cfg, warmup=False, **kw).digest
+
+
+# --- metrics: labeled gauge families ----------------------------------------
+
+def test_labeled_gauge_set_get_remove_snapshot():
+    obs_metrics.reset()
+    g = obs_metrics.labeled_gauge("svc_test_rounds")
+    g.set(32, job="j0001")
+    g.set(64, job="j0002")
+    g.set(48, job="j0001")  # last write wins per child
+    assert g.get(job="j0001") == 48
+    assert g.get(job="missing") is None
+    snap = obs_metrics.snapshot()["svc_test_rounds"]
+    assert snap["type"] == "labeled_gauge"
+    assert snap["series"] == [
+        {"labels": {"job": "j0001"}, "value": 48},
+        {"labels": {"job": "j0002"}, "value": 64}]
+    g.remove(job="j0001")
+    assert g.get(job="j0001") is None
+    with pytest.raises(ValueError, match="at least one label"):
+        g.set(1)
+
+
+def test_labeled_gauge_prometheus_rendering_and_type_collision():
+    obs_metrics.reset()
+    obs_metrics.labeled_gauge("svc_test_eta").set(1.5, job='a"b')
+    text = obs_metrics.to_prometheus()
+    assert "# TYPE svc_test_eta gauge" in text
+    assert 'svc_test_eta{job="a\\"b"} 1.5' in text
+    with pytest.raises(TypeError, match="already registered"):
+        obs_metrics.gauge("svc_test_eta")
+
+
+def test_labeled_gauge_metrics_snapshot_validates(tmp_path):
+    obs_metrics.reset()
+    obs_metrics.labeled_gauge("svc_test_rounds").set(5, job="j1")
+    path = tmp_path / "m.json"
+    path.write_text(json.dumps({"version": 1,
+                                "metrics": obs_metrics.snapshot()}))
+    assert vt.validate_metrics(str(path)) == []
+    bad = {"version": 1, "metrics": {"x": {
+        "type": "labeled_gauge",
+        "series": [{"labels": {}, "value": 1}]}}}
+    path.write_text(json.dumps(bad))
+    assert vt.validate_metrics(str(path))
+
+
+# --- serve: routes + port-in-use + idempotent close -------------------------
+
+def test_serve_routes_dispatch_get_post_and_404():
+    calls = []
+
+    def route(method, path, body):
+        calls.append((method, path, body))
+        return 200, "application/json", b'{"ok": true}\n'
+
+    with obs_serve.MetricsServer(0, routes={"/jobs": route}) as srv:
+        url = f"http://127.0.0.1:{srv.port}"
+        assert _get(url, "/jobs") == {"ok": True}
+        assert _get(url, "/jobs/j0001") == {"ok": True}  # prefix match
+        req = urllib.request.Request(url + "/jobs", data=b'{"a":1}',
+                                     method="POST")
+        urllib.request.urlopen(req, timeout=10)
+        # built-ins still win over the mounted prefix tree
+        assert "uptime_s" in _get(url, "/status")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(url, "/nope")
+        assert exc.value.code == 404
+    assert ("GET", "/jobs", b"") in calls
+    assert ("POST", "/jobs", b'{"a":1}') in calls
+
+
+def test_serve_port_in_use_is_a_clear_error_and_close_idempotent():
+    srv = obs_serve.MetricsServer(0)
+    with pytest.raises(obs_serve.PortInUseError,
+                       match="already in use"):
+        obs_serve.MetricsServer(srv.port)
+    srv.close()
+    srv.close()  # idempotent: a second close must not raise
+
+
+# --- job queue ---------------------------------------------------------------
+
+def test_queue_submit_validates_at_admission(tmp_path):
+    q = JobQueue(tmp_path)
+    with pytest.raises(ValueError):
+        q.submit(dict(BASE, protocol="nope"))
+    with pytest.raises(ValueError, match="seeds has"):
+        q.submit(BASE, seeds=[1, 2, 3])
+    with pytest.raises(ValueError):
+        q.submit(BASE, scenario="no-such-scenario")
+    with pytest.raises(ValueError, match="explicit seeds"):
+        q.submit(dict(BASE, n_rounds=96, n_nodes=7),
+                 seeds=[1, 2], scenario="delay-storm")
+    with pytest.raises(ValueError, match="engine='tpu'"):
+        q.submit(dict(BASE, engine="cpu", n_rounds=96, n_nodes=7),
+                 scenario="delay-storm")
+    assert q.jobs() == []  # nothing half-admitted
+
+
+def test_queue_journal_roundtrip_and_readmission(tmp_path):
+    q = JobQueue(tmp_path)
+    j1 = q.submit(BASE, name="a")
+    j2 = q.submit(OTHER, seeds=[7, 8])
+    assert (j1.id, j2.id) == ("j0001", "j0002")
+    j1.status = "running"
+    q.update(j1)
+    assert not q.path.with_suffix(".tmp.json").exists()  # atomic write
+
+    q2 = JobQueue(tmp_path)  # the restart path
+    r1, r2 = (q2.get("j0001"), q2.get("j0002"))
+    assert r1.status == "queued" and r1.readmissions == 1
+    assert q2.readmitted == ["j0001"]
+    assert r2.status == "queued" and r2.seeds == [7, 8]
+    assert r2.config["protocol"] == "paxos"
+    # The re-admission was persisted, not just in-memory
+    assert JobQueue(tmp_path).get("j0001").readmissions == 1
+
+
+def test_job_order_is_numeric_past_the_zero_padding():
+    from consensus_tpu.service.jobs import job_order
+    ids = ["j10000", "j2000", "j0999", "j9999"]
+    assert sorted(ids, key=job_order) == ["j0999", "j2000", "j9999",
+                                          "j10000"]
+
+
+def test_default_job_names_distinguish_shapes_not_seeds(tmp_path):
+    """Default names key LEDGER series: different configs must never
+    share one, same-shape different-seed jobs must (one honest
+    series)."""
+    q = JobQueue(tmp_path)
+    a = q.submit(BASE)
+    b = q.submit(dict(BASE, seed=99))            # same shape
+    c = q.submit(dict(BASE, drop_rate=0.3))      # different workload
+    assert a.name == b.name
+    assert a.name != c.name
+    assert a.name.startswith("raft-5n-64r-")
+
+
+def test_job_report_fields_match_validator_registry(tmp_path):
+    assert set(JOB_REPORT_FIELDS) == vt.SERVICE_JOB_FIELDS
+    q = JobQueue(tmp_path)
+    job = q.submit(BASE)
+    job.status = "failed"
+    job.error = "boom"
+    job.finished_unix = time.time()
+    q.update(job)
+    q.write_reports(tmp_path / "r.json", "cpu")
+    assert vt.validate_service_jobs(str(tmp_path / "r.json")) == []
+    row = job_report_row(job, "cpu")
+    assert set(row) == set(JOB_REPORT_FIELDS)
+
+
+# --- batcher -----------------------------------------------------------------
+
+def _job(q, config, **kw):
+    return q.submit(config, **kw)
+
+
+def test_plan_merges_sweep_compatible_pairs(tmp_path):
+    q = JobQueue(tmp_path)
+    a = _job(q, BASE)
+    b = _job(q, dict(BASE, seed=77, n_sweeps=3))  # seed/sweeps differ only
+    c = _job(q, OTHER)
+    plan = batcher.plan([a, b, c])
+    kinds = {p.kind: [j.id for j in p.jobs] for p in plan}
+    assert kinds["merged"] == [a.id, b.id]
+    assert kinds["solo"] == [c.id]
+
+
+def test_plan_knob_lanes_require_matching_gates(tmp_path):
+    q = JobQueue(tmp_path)
+    kc = dict(BASE, telemetry_window=4, drop_rate=0.2)
+    a = _job(q, kc)
+    b = _job(q, dict(kc, drop_rate=0.4, seed=9))      # knob value only
+    c = _job(q, dict(kc, crash_prob=0.1, recover_prob=0.3))  # gate flips
+    d = _job(q, dict(BASE, drop_rate=0.2))            # recorder off
+    plan = batcher.plan([a, b, c, d])
+    knob_batches = [p for p in plan if p.kind == "knobs"]
+    assert len(knob_batches) == 1
+    assert [j.id for j in knob_batches[0].jobs] == [a.id, b.id]
+    solo_ids = [p.jobs[0].id for p in plan if p.kind == "solo"]
+    assert sorted(solo_ids) == [c.id, d.id]
+
+
+def test_plan_solo_fallbacks(tmp_path):
+    q = JobQueue(tmp_path)
+    a = _job(q, dict(BASE, n_rounds=96, n_nodes=7, log_capacity=32),
+             scenario="delay-storm")
+    b = _job(q, dict(BASE, engine="cpu"))
+    c = _job(q, dict(BASE, n_sweeps=4, sweep_chunk=2))
+    for job in (a, b, c):
+        assert batcher.sweep_key(job) is None
+        assert batcher.knob_key(job) is None
+    plan = batcher.plan([a, b, c])
+    assert [p.kind for p in plan] == ["solo"] * 3
+
+
+def test_executable_cache_key_ignores_seed_only(tmp_path):
+    cache = batcher.ExecutableCache()
+    k1 = cache.key("run", _cfg(BASE))
+    k2 = cache.key("run", _cfg(dict(BASE, seed=99)))
+    k3 = cache.key("run", _cfg(dict(BASE, n_sweeps=3)))
+    assert k1 == k2 and k1 != k3
+    assert cache.admit(k1) is False
+    assert cache.admit(k2) is True
+    assert (cache.hits, cache.misses) == (1, 1)
+
+
+def test_effective_seeds_explicit_and_derived(tmp_path):
+    q = JobQueue(tmp_path)
+    a = _job(q, dict(BASE, seed=5, n_sweeps=3))
+    np.testing.assert_array_equal(batcher.effective_seeds(a),
+                                  np.asarray([5, 6, 7], np.uint32))
+    b = _job(q, BASE, seeds=[11, 12])
+    np.testing.assert_array_equal(batcher.effective_seeds(b),
+                                  np.asarray([11, 12], np.uint32))
+
+
+# --- end-to-end: the acceptance contract ------------------------------------
+
+def test_service_batches_compatible_pair_and_digests_bit_identical(
+        tmp_path, monkeypatch):
+    """ISSUE acceptance: two jobs sharing a (protocol, shape) + one
+    incompatible job submitted concurrently — the compatible pair
+    provably shares one compiled program (every raft dispatch span
+    covers the PAIR: exactly the chunk count of one merged run, not
+    2x), and every job's digest is bit-identical to its standalone
+    runner run."""
+    obs_metrics.reset()
+    q = JobQueue(tmp_path / "state")
+    a = q.submit(BASE)
+    b = q.submit(dict(BASE, seed=77, n_sweeps=3))
+    c = q.submit(OTHER)
+    trace = tmp_path / "t.jsonl"
+    obs_trace.configure(str(trace))
+    try:
+        with SweepService(tmp_path / "state", port=0, platform="cpu",
+                          batch_window_s=0, poll_s=0.01) as svc:
+            url = f"http://127.0.0.1:{svc.port}"
+            assert svc.wait_idle(180), _get(url, "/jobs")
+            docs = {i: _get(url, f"/jobs/{i}")
+                    for i in (a.id, b.id, c.id)}
+    finally:
+        obs_trace.close()
+
+    assert docs[a.id]["batch"] == [a.id, b.id]
+    assert docs[b.id]["batch"] == [a.id, b.id]
+    assert docs[c.id]["batch"] is None
+    for job, config in ((a, BASE),
+                        (b, dict(BASE, seed=77, n_sweeps=3)),
+                        (c, OTHER)):
+        doc = docs[job.id]
+        assert doc["status"] == "done", doc
+        assert doc["result"]["digest"] == _standalone_digest(config)
+
+    spans = [json.loads(line)
+             for line in trace.read_text().splitlines()[1:]]
+    disp = [s for s in spans if s.get("type") == "span"
+            and s.get("name") == "dispatch"]
+    # checkpoint-implied chunking: 64 rounds -> 2 chunks of 32 for the
+    # merged raft PAIR, 48 -> 2 chunks of 24 for the solo paxos run.
+    # 4 spans total — 6 would mean the pair ran separately.
+    by_engine: dict = {}
+    for s in disp:
+        by_engine.setdefault(s["attrs"]["engine"], []).append(s)
+    assert len(by_engine["raft"]) == 2, by_engine
+    assert len(by_engine["paxos"]) == 2, by_engine
+    kinds = [s["attrs"]["kind"] for s in spans
+             if s.get("type") == "span" and s["name"] == "service_batch"]
+    assert sorted(kinds) == ["merged", "solo"]
+
+
+def test_service_knob_jobs_share_one_dispatch(tmp_path):
+    """Tenants differing only in adversary knob values run as traced
+    lanes of ONE run_knob_batch dispatch; digests stay bit-identical
+    to their standalone runs (the PR 12 lane contract, now multi-
+    tenant)."""
+    obs_metrics.reset()
+    kc = dict(BASE, telemetry_window=4, drop_rate=0.2, seed=5)
+    kd = dict(kc, drop_rate=0.45, seed=9)
+    q = JobQueue(tmp_path / "state")
+    a, b = q.submit(kc), q.submit(kd)
+    trace = tmp_path / "t.jsonl"
+    obs_trace.configure(str(trace))
+    try:
+        with SweepService(tmp_path / "state", port=0, platform="cpu",
+                          batch_window_s=0, poll_s=0.01) as svc:
+            url = f"http://127.0.0.1:{svc.port}"
+            assert svc.wait_idle(180), _get(url, "/jobs")
+            docs = {i: _get(url, f"/jobs/{i}") for i in (a.id, b.id)}
+    finally:
+        obs_trace.close()
+    assert docs[a.id]["batch"] == [a.id, b.id]
+    for job, config in ((a, kc), (b, kd)):
+        assert docs[job.id]["result"]["digest"] == \
+            _standalone_digest(config)
+    spans = [json.loads(line)
+             for line in trace.read_text().splitlines()[1:]]
+    disp = [s for s in spans if s.get("type") == "span"
+            and s.get("name") == "dispatch"]
+    assert len(disp) == 1, disp
+    assert disp[0]["attrs"]["n_candidates"] == 4  # 2 jobs x 2 sweeps
+
+
+def test_service_executable_cache_hit_no_recompile(tmp_path):
+    """A repeat shape (same config, different seed) is an executable-
+    cache hit: the /jobs doc says so, the counter moves, and — the
+    hard witness — runner._chunk_jit's cache does NOT grow for the
+    second job."""
+    obs_metrics.reset()
+    q = JobQueue(tmp_path / "state")
+    first = q.submit(BASE)
+    with SweepService(tmp_path / "state", port=0, platform="cpu",
+                      batch_window_s=0, poll_s=0.01) as svc:
+        url = f"http://127.0.0.1:{svc.port}"
+        assert svc.wait_idle(180)
+        assert _get(url, f"/jobs/{first.id}")["cache_hit"] is False
+        size_before = runner._chunk_jit._cache_size()
+        second = _post(url, {"config": dict(BASE, seed=1234)})
+        deadline = time.time() + 120
+        while _get(url, f"/jobs/{second['id']}")["status"] != "done":
+            assert time.time() < deadline
+            time.sleep(0.05)
+        doc = _get(url, f"/jobs/{second['id']}")
+        assert doc["cache_hit"] is True
+        assert runner._chunk_jit._cache_size() == size_before
+        assert doc["result"]["digest"] == \
+            _standalone_digest(dict(BASE, seed=1234))
+        snap = obs_metrics.snapshot()
+        assert snap["service_exec_cache_hits_total"]["value"] >= 1
+
+
+def test_service_scenario_job_carries_verdict(tmp_path):
+    """A scenario job runs the scripted attack exactly like the CLI's
+    --scenario: overrides applied at execution, the timeline verdict in
+    the job doc and the report row (delay-storm at its tuned shape)."""
+    obs_metrics.reset()
+    shape = dict(protocol="raft", engine="tpu", n_nodes=7, n_rounds=96,
+                 n_sweeps=2, seed=11, log_capacity=32, max_entries=24)
+    q = JobQueue(tmp_path / "state")
+    job = q.submit(shape, scenario="delay-storm")
+    with SweepService(tmp_path / "state", port=0, platform="cpu",
+                      batch_window_s=0, poll_s=0.01) as svc:
+        url = f"http://127.0.0.1:{svc.port}"
+        assert svc.wait_idle(240)
+        doc = _get(url, f"/jobs/{job.id}")
+    assert doc["status"] == "done", doc
+    verdict = doc["result"]["scenario"]
+    assert verdict["name"] == "delay-storm" and verdict["passed"], verdict
+    # the verdict is durable: re-read through a fresh journal load
+    row = job_report_row(JobQueue(tmp_path / "state").get(job.id), "cpu")
+    assert row["scenario_passed"] is True
+
+
+def test_service_durability_restart_resumes_mid_scan(tmp_path):
+    """Tier-1 doctored-layout durability (the real-SIGKILL daemon
+    version is the slow tier's): a job journaled as RUNNING with a
+    genuine mid-run snapshot in its own directory is re-admitted on
+    restart and RESUMED from round 32 — not recomputed — with the
+    digest bit-identical to an uninterrupted standalone runner.run."""
+    obs_metrics.reset()
+    state = tmp_path / "state"
+    q = JobQueue(state)
+    job = q.submit(dict(BASE, n_rounds=64))
+    # Doctor the in-flight state the way a killed daemon leaves it:
+    # status=running in the journal, a valid snapshot at round 32 under
+    # the job's own directory, written against the service's normalized
+    # dispatch config (seed=0 + explicit seeds).
+    cfg = job.cfg()
+    seeds = batcher.effective_seeds(job)
+    norm = batcher.normalized(cfg, cfg.n_sweeps)
+    eng = simulator.engine_def(norm)
+    carry = runner._init_jit(norm, eng, jnp.asarray(seeds))
+    carry = runner._chunk_jit(norm, eng, 32, carry, jnp.int32(0))
+    ckpt = q.job_dir(job.id) / "ck.npz"
+    runner.save_checkpoint(ckpt, norm, carry, 32, seeds=seeds)
+    job.status = "running"
+    q.update(job)
+
+    with SweepService(state, port=0, platform="cpu",
+                      batch_window_s=0, poll_s=0.01) as svc:
+        assert svc.queue.readmitted == [job.id]
+        assert svc.wait_idle(180)
+        doc = svc.queue.get(job.id)
+    assert doc.status == "done", (doc.status, doc.error)
+    assert doc.readmissions == 1
+    assert doc.result["resumed_from_round"] == 32  # resumed, not rerun
+    # Honest ledger accounting: steps count only the 32 rounds this
+    # execution ran, not the checkpointed prefix (full-run steps over
+    # a resumed wall clock would fake a throughput gain).
+    assert doc.result["steps"] == 2 * 5 * 32
+    assert doc.result["digest"] == _standalone_digest(dict(BASE,
+                                                           n_rounds=64))
+
+
+def test_service_grouped_job_uses_group_dir_layout(tmp_path):
+    """A job asking for sweep_chunk grouping runs solo through the
+    per-job --group-dir layout: per-group snapshot subdirectories +
+    completed-group manifest under the job's own directory."""
+    obs_metrics.reset()
+    config = dict(BASE, n_sweeps=4, sweep_chunk=2, scan_chunk=16)
+    state = tmp_path / "state"
+    q = JobQueue(state)
+    job = q.submit(config)
+    with SweepService(state, port=0, platform="cpu",
+                      batch_window_s=0, poll_s=0.01) as svc:
+        assert svc.wait_idle(180)
+        doc = svc.queue.get(job.id)
+    assert doc.status == "done", (doc.status, doc.error)
+    groups = q.job_dir(job.id) / "groups"
+    assert (groups / "groups.json").exists()
+    assert (groups / "group_0000" / "ck.npz").exists()
+    assert doc.result["digest"] == _standalone_digest(config)
+
+
+def test_service_http_api_validation_errors(tmp_path):
+    obs_metrics.reset()
+    with SweepService(tmp_path / "state", port=0, platform="cpu",
+                      poll_s=0.01) as svc:
+        url = f"http://127.0.0.1:{svc.port}"
+        for body, needle in (
+                (b"not json", "must be JSON"),
+                (b"{}", "missing 'config'"),
+                (json.dumps({"config": dict(BASE, protocol="nope")})
+                 .encode(), "protocol")):
+            req = urllib.request.Request(url + "/jobs", data=body,
+                                         method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 400
+            assert needle in json.loads(exc.value.read())["error"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(url, "/jobs/j9999")
+        assert exc.value.code == 404
+        status = _get(url, "/status")
+        assert status["service"] == "sweepd"
+        assert status["jobs"] == {"queued": 0, "running": 0, "done": 0,
+                                  "failed": 0}
+
+
+def test_service_reports_validate_and_fold_into_ledger(tmp_path):
+    """Completed-job rows validate against the field registry and fold
+    into a ledger build as `service-job` rows with `new` single-point
+    verdicts — never touching the regression list."""
+    import tools.ledger as ledger
+    obs_metrics.reset()
+    state = tmp_path / "state"
+    q = JobQueue(state)
+    job = q.submit(BASE, name="svc-test-raft")
+    with SweepService(state, port=0, platform="cpu", poll_s=0.01,
+                      batch_window_s=0) as svc:
+        assert svc.wait_idle(180)
+    reports = state / "job_reports.json"
+    assert vt.validate_service_jobs(str(reports)) == []
+
+    repo = tmp_path / "repo"
+    (repo / "benchmarks" / "parts").mkdir(parents=True)
+    (repo / "benchmarks" / "parts" / "service_jobs.json").write_text(
+        reports.read_text())
+    doc = ledger.build(repo)
+    rows = [r for r in doc["rows"] if r["kind"] == "service-job"]
+    assert len(rows) == 1 and rows[0]["name"] == "svc-test-raft"
+    assert rows[0]["ok"] is True and len(rows[0]["digest"]) == 64
+    assert doc["series"]["svc-test-raft@cpu"]["verdict"] == "new"
+    assert doc["regressions"] == []
+    # the job is one digest-bearing measurement (fresh journal load —
+    # the service persisted the result the moment the batch finished)
+    done = JobQueue(state).get(job.id)
+    assert rows[0]["digest"] == (done.result or {})["digest"]
+
+
+def test_committed_service_jobs_artifact_schema_valid():
+    path = REPO / "benchmarks" / "parts" / "service_jobs.json"
+    assert path.exists(), "the committed sweepd report artifact is gone"
+    assert vt.validate_service_jobs(str(path)) == []
+    doc = json.loads(path.read_text())
+    assert all(r["status"] == "done" for r in doc["rows"])
+
+
+# --- CLI client mode ---------------------------------------------------------
+
+def test_cli_submit_and_wait(tmp_path, capsys):
+    obs_metrics.reset()
+    with SweepService(tmp_path / "state", port=0, platform="cpu",
+                      batch_window_s=0, poll_s=0.01) as svc:
+        url = f"http://127.0.0.1:{svc.port}"
+        rc = cli.main(["--protocol", "raft", "--nodes", "5",
+                       "--rounds", "64", "--sweeps", "2", "--seed", "3",
+                       "--log-capacity", "32", "--max-entries", "24",
+                       "--submit", url, "--job-name", "cli-job"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["name"] == "cli-job" and doc["status"] == "queued"
+        rc = cli.main(["--protocol", "raft", "--nodes", "5",
+                       "--rounds", "64", "--sweeps", "2", "--seed", "88",
+                       "--log-capacity", "32", "--max-entries", "24",
+                       "--submit", url, "--submit-wait"])
+        assert rc == 0
+        final = json.loads(capsys.readouterr().out)
+        assert final["status"] == "done"
+        assert final["result"]["digest"] == _standalone_digest(
+            dict(BASE, seed=88))
+
+
+def test_cli_submit_rejects_local_execution_flags(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        cli.main(["--protocol", "raft", "--submit", "http://x",
+                  "--checkpoint", str(tmp_path / "ck.npz")])
+    assert "--checkpoint" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        cli.main(["--protocol", "raft", "--submit-wait"])
+    assert "--submit-wait requires --submit" in capsys.readouterr().err
+
+
+def test_cli_submit_unreachable_service_is_a_clean_error(capsys):
+    rc = cli.main(["--protocol", "raft",
+                   "--submit", "http://127.0.0.1:9"])  # reserved port
+    assert rc == 2
+    assert "cannot reach" in capsys.readouterr().err
+
+
+def test_cli_submit_rejection_round_trips_the_service_error(tmp_path,
+                                                            capsys):
+    obs_metrics.reset()
+    with SweepService(tmp_path / "state", port=0, platform="cpu",
+                      poll_s=0.01) as svc:
+        url = f"http://127.0.0.1:{svc.port}"
+        rc = cli.main(["--protocol", "pbft", "--f", "1",
+                       "--scenario", "no-such-scenario",
+                       "--submit", url])
+    assert rc == 2
+    assert "no-such-scenario" in capsys.readouterr().err
+
+
+# --- hotstuff advsearch space (satellite) -----------------------------------
+
+def test_hotstuff_advsearch_space_registered():
+    """The view-timeout-storm search space: hotstuff protocol, short
+    pacemaker timeout + bounded delay as static axes, mirrored (its
+    knobs are all oracle-implemented, so findings CAN distill)."""
+    from tools.advsearch.search import RATE_CUTOFFS, SPACES
+    sp = SPACES["hotstuff-views"]
+    assert sp.base.protocol == "hotstuff"
+    assert sp.mirrored, "drop/partition/churn/delay are all mirrored"
+    assert sp.base.view_timeout == 4      # the storm axis: short views
+    assert sp.base.max_delay_rounds == 4  # §A.2 retransmissions on
+    assert {k.field for k in sp.knobs} == {"drop_rate",
+                                           "partition_rate",
+                                           "churn_rate"}
+    assert all(k.field in RATE_CUTOFFS for k in sp.knobs)
+    # gate-representativity + range validity are construction-checked
+    # (Space.__post_init__) and covered for every space by
+    # tests/test_advsearch.py::test_space_definitions_are_gate_
+    # representative.
+
+
+# --- slow tier: the real daemon killed for real ------------------------------
+
+@pytest.mark.slow
+def test_daemon_sigkill_mid_job_restart_resumes_bit_identical(tmp_path):
+    """ISSUE satellite: SIGKILL the daemon subprocess mid-job, restart
+    it over the same state dir, and the finished job's digest is
+    bit-identical to an uninterrupted standalone runner.run."""
+    state = tmp_path / "state"
+    config = dict(BASE, n_rounds=512, scan_chunk=16)
+
+    def start():
+        port_file = tmp_path / f"port-{time.time_ns()}"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "consensus_tpu.service", "--port",
+             "0", "--state-dir", str(state), "--platform", "cpu",
+             "--port-file", str(port_file), "--batch-window", "0"],
+            cwd=REPO)
+        deadline = time.time() + 120
+        while not port_file.exists():
+            assert proc.poll() is None, "daemon died at startup"
+            assert time.time() < deadline, "daemon never bound"
+            time.sleep(0.1)
+        return proc, f"http://127.0.0.1:{port_file.read_text().strip()}"
+
+    proc, url = start()
+    try:
+        jid = _post(url, {"config": config})["id"]
+        # Wait until the job is demonstrably mid-flight (some rounds
+        # done, not all), then SIGKILL — no graceful anything.
+        deadline = time.time() + 180
+        while True:
+            doc = _get(url, f"/jobs/{jid}")
+            done = doc.get("rounds_completed", 0)
+            if doc["status"] == "running" and 0 < done < 512:
+                break
+            assert doc["status"] != "done", \
+                "job finished before the kill — raise n_rounds"
+            assert time.time() < deadline
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    proc, url = start()
+    try:
+        deadline = time.time() + 300
+        while True:
+            doc = _get(url, f"/jobs/{jid}")
+            if doc["status"] in ("done", "failed"):
+                break
+            assert time.time() < deadline
+            time.sleep(0.2)
+        assert doc["status"] == "done", doc.get("error")
+        assert doc["readmissions"] >= 1
+        assert doc["result"]["digest"] == _standalone_digest(config)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_plan_is_deterministic_for_restart_reformation(tmp_path):
+    """The merged-batch checkpoint story rests on this: the same
+    re-admitted journal produces the same plan (same batches, same
+    member order), so a restarted daemon finds its batch snapshots."""
+    q = JobQueue(tmp_path)
+    jobs = [q.submit(BASE), q.submit(dict(BASE, seed=77)),
+            q.submit(OTHER), q.submit(dict(BASE, seed=5, n_sweeps=4))]
+    p1 = batcher.plan(jobs)
+    p2 = batcher.plan([JobQueue(tmp_path).get(j.id) for j in jobs])
+    assert [(b.kind, tuple(j.id for j in b.jobs)) for b in p1] == \
+        [(b.kind, tuple(j.id for j in b.jobs)) for b in p2]
+    assert p1[0].kind == "merged" and len(p1[0].jobs) == 3

@@ -170,6 +170,26 @@ SPACES: dict[str, Space] = {s.name: s for s in (
                KnobRange("crash_prob", 0.0, 0.30),
                KnobRange("recover_prob", 0.05, 0.50))),
     Space(
+        name="hotstuff-views",
+        description="Chained-HotStuff view-timeout storms (SPEC §7b): "
+                    "loss/partition/churn-driven QC starvation under "
+                    "bounded §A.2 delayed retransmissions, at a SHORT "
+                    "pacemaker timeout (view_timeout 4 and "
+                    "max_delay_rounds 4 are the static axes) — hunting "
+                    "knob compositions where failed views cascade "
+                    "faster than the consecutive-view 3-chain can "
+                    "re-form, so blocks keep certifying but chain "
+                    "commits stall (chain_commit_lag, availability "
+                    "dips the hand-built chained-commit-stall scenario "
+                    "never composes with partitions).",
+        base=Config(protocol="hotstuff", f=2, n_nodes=7,
+                    log_capacity=96, view_timeout=4, drop_rate=0.3,
+                    partition_rate=0.1, churn_rate=0.02,
+                    max_delay_rounds=4, **_ADV),
+        knobs=(KnobRange("drop_rate", 0.05, 0.60),
+               KnobRange("partition_rate", 0.0, 0.40),
+               KnobRange("churn_rate", 0.0, 0.15))),
+    Space(
         name="raft-attack-elect",
         description="SPEC §A.3 repeated election disruption: how low "
                     "an attack_rate still denies liveness. TPU-only "

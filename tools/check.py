@@ -24,6 +24,10 @@ Layers (docs/STATIC_ANALYSIS.md):
            budget, fixed seed, CPU backend): one-compiled-program-per-
            generation witnessed on its own trace + findings schema
            (`make advsearch-smoke`)                      [gated on jax]
+  service — the sweepd smoke (docs/SERVICE.md): ephemeral-port daemon,
+           two compatible + one incompatible job, batching/digest/
+           metrics asserted over the live API, clean SIGTERM shutdown
+           (`make service-smoke`)                        [gated on jax]
   tests  — the tier-1 pytest suite (JAX_PLATFORMS=cpu, -m 'not slow')
 
 "Gated" layers SKIP with a loud notice when their tool is not
@@ -162,6 +166,106 @@ def layer_advsearch(_: argparse.Namespace) -> str:
                            "smoke"], env=env) else "ok"
 
 
+def layer_service(_: argparse.Namespace) -> str:
+    """The sweepd smoke (docs/SERVICE.md): start a daemon on an
+    ephemeral port (CPU backend), submit two compatible jobs + one
+    incompatible, and assert on the live API what the service promises
+    — the compatible pair shares one batch (one compiled program), the
+    incompatible job runs alone, every job finishes with a decided-log
+    digest, /metrics carries the fleet counters, and SIGTERM shuts the
+    daemon down cleanly."""
+    import importlib.util
+    if importlib.util.find_spec("jax") is None:
+        return "SKIP (jax not installed)"
+    import json
+    import signal
+    import tempfile
+    import urllib.request
+
+    td = tempfile.mkdtemp(prefix="sweepd-smoke")
+    port_file = os.path.join(td, "port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "consensus_tpu.service", "--port", "0",
+           "--state-dir", os.path.join(td, "state"), "--platform", "cpu",
+           "--port-file", port_file]
+    print(f"check: $ {' '.join(cmd)}", flush=True)
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env)
+
+    def fail(msg: str) -> str:
+        print(f"check: service smoke: {msg}", flush=True)
+        proc.kill()
+        return "FAIL"
+
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                return fail(f"daemon exited rc={proc.returncode} "
+                            "before binding")
+            if time.time() > deadline:
+                return fail("daemon never wrote its port file")
+            time.sleep(0.2)
+        url = f"http://127.0.0.1:{open(port_file).read().strip()}"
+
+        def call(path: str, doc=None):
+            data = json.dumps(doc).encode() if doc is not None else None
+            req = urllib.request.Request(url + path, data=data,
+                                         method="POST" if data else "GET")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read().decode())
+
+        base = {"protocol": "raft", "engine": "tpu", "n_nodes": 5,
+                "n_rounds": 48, "n_sweeps": 2, "seed": 3,
+                "log_capacity": 32, "max_entries": 24}
+        ids = [call("/jobs", {"config": base})["id"],
+               call("/jobs", {"config": dict(base, seed=77)})["id"],
+               call("/jobs", {"config": dict(base, protocol="paxos",
+                                             n_nodes=9)})["id"]]
+        deadline = time.time() + 240
+        while True:
+            docs = [call(f"/jobs/{i}") for i in ids]
+            if all(d["status"] in ("done", "failed") for d in docs):
+                break
+            if time.time() > deadline:
+                return fail(f"jobs never finished: "
+                            f"{[d['status'] for d in docs]}")
+            time.sleep(0.3)
+        for d in docs:
+            if d["status"] != "done" or len(
+                    (d.get("result") or {}).get("digest") or "") != 64:
+                return fail(f"job {d['id']}: status {d['status']}, "
+                            f"error {d.get('error')}")
+        pair, solo = docs[0], docs[2]
+        if pair["batch"] != ids[:2] or docs[1]["batch"] != ids[:2]:
+            return fail(f"compatible pair did not share a batch: "
+                        f"{[d['batch'] for d in docs]}")
+        if solo["batch"] is not None:
+            return fail(f"incompatible job joined batch {solo['batch']}")
+        listing = call("/jobs")
+        if len(listing["jobs"]) != 3:
+            return fail(f"/jobs listed {len(listing['jobs'])} jobs")
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            metrics = r.read().decode()
+        # (the per-job labeled-gauge children are removed as jobs
+        # finish — a bounded family on a long-lived daemon — so the
+        # post-completion scrape asserts the fleet counters)
+        for needle in ("service_jobs_completed_total 3",
+                       "service_batches_total 2",
+                       "service_queue_depth 0"):
+            if needle not in metrics:
+                return fail(f"/metrics missing {needle!r}")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        if rc != 0:
+            return fail(f"SIGTERM shutdown exited rc={rc}")
+    except Exception as exc:  # noqa: BLE001 — smoke harness boundary
+        return fail(f"{type(exc).__name__}: {exc}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    return "ok"
+
+
 def layer_tests(args: argparse.Namespace) -> str:
     if args.skip_tests:
         return "SKIP (--skip-tests)"
@@ -173,7 +277,7 @@ LAYERS = {"lint": layer_lint, "hlo": layer_hlo,
           "costcheck": layer_costcheck, "ruff": layer_ruff,
           "mypy": layer_mypy, "tidy": layer_tidy,
           "scenarios": layer_scenarios, "advsearch": layer_advsearch,
-          "tests": layer_tests}
+          "service": layer_service, "tests": layer_tests}
 
 
 def main(argv=None) -> int:

@@ -7,9 +7,11 @@
 
 The repo's perf history is scattered: driver captures (``BENCH_r*.json``,
 one per growth round, stdout-scraped), multi-chip dry runs
-(``MULTICHIP_r*.json``), and the merged on-chip benchmark artifact
+(``MULTICHIP_r*.json``), the merged on-chip benchmark artifact
 (``benchmarks/RESULTS.json`` with embedded bandwidth floors + metrics
-snapshots). This tool folds them — plus the compiled cost model's
+snapshots), and sweep-service completed-job reports
+(``benchmarks/parts/service_jobs.json``, published by a sweepd daemon —
+docs/SERVICE.md). This tool folds them — plus the compiled cost model's
 roofline predictions (``benchmarks/parts/costcards/``) — into ONE
 ``benchmarks/LEDGER.json``:
 
@@ -222,6 +224,54 @@ def bench_rows(repo: pathlib.Path, cards: dict[str, dict]) -> list[dict]:
     return out
 
 
+def service_rows(repo: pathlib.Path, cards: dict[str, dict]) -> list[dict]:
+    """Rows from a published sweepd completed-job report
+    (``benchmarks/parts/service_jobs.json``, written by
+    ``python -m consensus_tpu.service --publish``; row schema =
+    consensus_tpu/service/jobs.py JOB_REPORT_FIELDS, checked by
+    ``tools/validate_trace.py --service-jobs``). Each finished job is
+    one measurement: done jobs carry their decided-log digest and
+    throughput; failed jobs stay visible as ok=false rows like failed
+    driver rounds. Batched jobs note their shared-program batch so a
+    throughput reader knows the wall clock covered the whole batch."""
+    path = repo / "benchmarks" / "parts" / "service_jobs.json"
+    if not path.exists():
+        return []
+    try:
+        doc = json.loads(path.read_text())
+    except ValueError:
+        return []
+    out = []
+    for r in doc.get("rows", []):
+        name = r.get("name") or "?"
+        plat = r.get("platform")
+        sps = r.get("steps_per_sec") or None
+        pred = _predicted(cards, name) if _plat_class(plat) == "tpu" \
+            else None
+        notes = []
+        if r.get("batch"):
+            notes.append(f"batched:{'+'.join(r['batch'])}")
+        if r.get("cache_hit"):
+            notes.append("exec-cache-hit")
+        if r.get("scenario_passed") is not None:
+            notes.append(f"scenario_passed={r['scenario_passed']}")
+        if r.get("error"):
+            notes.append(str(r["error"])[:120])
+        out.append(_row(
+            source="benchmarks/parts/service_jobs.json",
+            kind="service-job", name=name, seq=None,
+            timestamp=r.get("finished_unix"), platform=plat,
+            engine=r.get("engine"), steps_per_sec=sps,
+            wall_s=r.get("wall_s"), steps=r.get("steps"),
+            digest=r.get("digest"), stale=None,
+            predicted_steps_per_sec=pred,
+            measured_vs_predicted=_ratio(sps, pred),
+            hbm_peak_frac_floor=None,
+            ok=r.get("status") == "done" and bool(sps),
+            notes=", ".join(notes) or None))
+    return out
+
+
 def multichip_rows(repo: pathlib.Path) -> list[dict]:
     out = []
     for fname in sorted(glob.glob(str(repo / "MULTICHIP_r*.json"))):
@@ -317,7 +367,7 @@ def build_series(rows: list[dict]) -> dict[str, dict]:
 def build(repo: pathlib.Path) -> dict[str, Any]:
     cards = _load_cards(repo)
     rows = (bench_rows(repo, cards) + multichip_rows(repo)
-            + results_rows(repo, cards))
+            + results_rows(repo, cards) + service_rows(repo, cards))
     series = build_series(rows)
     regressions = sorted(k for k, s in series.items()
                          if s["verdict"] == "regression")

@@ -140,6 +140,8 @@ FIELD_REGISTRIES = (
     ("tools/costmodel/model.py", "CARD_FIELDS", "COST_CARD_FIELDS"),
     ("tools/ledger.py", "ROW_FIELDS", "LEDGER_ROW_FIELDS"),
     ("tools/advsearch/search.py", "FINDING_FIELDS", "FINDING_FIELDS"),
+    ("consensus_tpu/service/jobs.py", "JOB_REPORT_FIELDS",
+     "SERVICE_JOB_FIELDS"),
 )
 
 

@@ -98,7 +98,7 @@ SPAN_NAMES = frozenset({
     "dispatch", "checkpoint_save", "checkpoint_load",
     "ckpt_snapshot", "ckpt_write",
     "warmup", "supervised_attempt", "oracle_fallback", "oracle_run",
-    "pbft_fsweep",
+    "pbft_fsweep", "service_batch",
 })
 EVENT_NAMES = frozenset({
     "attempt_failed", "backoff", "checkpoint_write_failed",
@@ -159,7 +159,20 @@ LEDGER_ROW_FIELDS = frozenset({
     "hbm_peak_frac_floor", "ok", "notes",
 })
 _LEDGER_KINDS = frozenset({"results-tpu", "results-oracle", "driver-bench",
-                           "multichip-dryrun"})
+                           "multichip-dryrun", "service-job"})
+
+# One sweep-service completed-job report row = exactly these keys
+# (consensus_tpu/service/jobs.py JOB_REPORT_FIELDS — lint-synced both
+# ways like the telemetry counters): the artifact a sweepd daemon
+# publishes (``--publish benchmarks/parts/service_jobs.json``) and
+# tools/ledger.py folds into LEDGER.json as ``service-job`` rows.
+SERVICE_JOB_FIELDS = frozenset({
+    "schema", "id", "name", "protocol", "engine", "platform", "n_nodes",
+    "n_rounds", "n_sweeps", "submitted_unix", "finished_unix", "wall_s",
+    "steps", "steps_per_sec", "digest", "status", "batch", "cache_hit",
+    "scenario_passed", "error",
+})
+_SERVICE_JOB_STATES = frozenset({"done", "failed"})
 # "new" = a single-point series (first measurement of a fresh config —
 # shielded from both regression directions); "single-point" is the
 # pre-rename alias, still accepted so committed LEDGER.json artifacts
@@ -417,6 +430,24 @@ def validate_metrics(path) -> list:
                     for k, v in labels.items()):
                 errs.append(f"{path}: info {name} labels must be a "
                             "str->str object")
+        elif typ == "labeled_gauge":
+            series = d.get("series")
+            if not isinstance(series, list):
+                errs.append(f"{path}: labeled_gauge {name} series must "
+                            "be a list")
+            else:
+                for k, child in enumerate(series):
+                    labels = (child.get("labels")
+                              if isinstance(child, dict) else None)
+                    if not isinstance(labels, dict) or not labels \
+                            or not all(isinstance(a, str)
+                                       and isinstance(b, str)
+                                       for a, b in labels.items()) \
+                            or not _num(child.get("value")):
+                        errs.append(
+                            f"{path}: labeled_gauge {name} series[{k}] "
+                            "must carry a non-empty str->str labels "
+                            "object and a numeric value")
         else:
             errs.append(f"{path}: metric {name!r} has unknown type {typ!r}")
     if "flight" in doc:
@@ -682,6 +713,60 @@ def validate_costcard(path) -> list:
     return errs
 
 
+def validate_service_jobs(path) -> list:
+    """Schema checks for a sweepd completed-job report artifact
+    (``{"version": 1, "rows": [...]}``, rows exactly the
+    SERVICE_JOB_FIELDS keys — the file ``tools/ledger.py`` ingests as
+    ``service-job`` rows when published under benchmarks/parts/)."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable/not JSON: {exc}"]
+    if not isinstance(doc, dict):
+        return [f"{path}: top level must be an object"]
+    errs = []
+    if doc.get("version") != 1:
+        errs.append(f"{path}: version {doc.get('version')!r} != 1")
+    rows = doc.get("rows")
+    if not isinstance(rows, list):
+        return errs + [f"{path}: 'rows' must be a list"]
+    for i, r in enumerate(rows):
+        if not isinstance(r, dict):
+            errs.append(f"{path}: rows[{i}] must be an object")
+            continue
+        for key in sorted(SERVICE_JOB_FIELDS - set(r)):
+            errs.append(f"{path}: rows[{i}] missing key {key!r}")
+        for key in sorted(set(r) - SERVICE_JOB_FIELDS):
+            errs.append(f"{path}: rows[{i}] key {key!r} is not in the "
+                        "known-field registry (service and validator "
+                        "drifted?)")
+        if r.get("schema") != 1:
+            errs.append(f"{path}: rows[{i}].schema "
+                        f"{r.get('schema')!r} != 1")
+        if r.get("status") not in _SERVICE_JOB_STATES:
+            errs.append(f"{path}: rows[{i}].status {r.get('status')!r} "
+                        f"not in {sorted(_SERVICE_JOB_STATES)} (only "
+                        "finished jobs are reportable)")
+        if r.get("status") == "done":
+            d = r.get("digest")
+            if not isinstance(d, str) or len(d) != 64:
+                errs.append(f"{path}: rows[{i}]: a done job must carry "
+                            "its 64-hex decided-log digest")
+            for key in ("wall_s", "steps_per_sec"):
+                if not _num(r.get(key)) or r[key] < 0:
+                    errs.append(f"{path}: rows[{i}].{key} must be a "
+                                "finite number >= 0 on a done job")
+        elif not r.get("error"):
+            errs.append(f"{path}: rows[{i}]: a failed job must carry "
+                        "its error")
+        b = r.get("batch")
+        if b is not None and (not isinstance(b, list) or not all(
+                isinstance(x, str) for x in b)):
+            errs.append(f"{path}: rows[{i}].batch must be null or a "
+                        "list of job ids")
+    return errs
+
+
 def validate_ledger(path) -> list:
     """Schema checks for benchmarks/LEDGER.json (tools/ledger.py): row
     keys against the registry, series verdicts from the known set, and
@@ -768,6 +853,11 @@ def main(argv=None) -> int:
     ap.add_argument("--ledger", default="",
                     help="the cross-run perf ledger "
                          "(benchmarks/LEDGER.json)")
+    ap.add_argument("--service-jobs", default="",
+                    help="a sweepd completed-job report artifact "
+                         "(the daemon's job_reports.json / --publish "
+                         "file); row fields are checked against the "
+                         "known-field registry")
     ap.add_argument("--expect-spans", default="",
                     help="comma-separated registered span names that MUST "
                          "appear in --trace (e.g. 'ckpt_snapshot,"
@@ -779,9 +869,11 @@ def main(argv=None) -> int:
                          "supervised-retry trace)")
     args = ap.parse_args(argv)
     if not (args.trace or args.metrics or args.report or args.cli_report
-            or args.costcard or args.ledger or args.finding):
+            or args.costcard or args.ledger or args.finding
+            or args.service_jobs):
         ap.error("nothing to validate: pass --trace/--metrics/--report/"
-                 "--cli-report/--costcard/--ledger/--finding")
+                 "--cli-report/--costcard/--ledger/--finding/"
+                 "--service-jobs")
     if (args.expect_spans or args.expect_events) and not args.trace:
         ap.error("--expect-spans/--expect-events need --trace (they assert "
                  "presence in that file)")
@@ -810,6 +902,8 @@ def main(argv=None) -> int:
         errs += validate_ledger(args.ledger)
     if args.finding:
         errs += validate_finding(args.finding)
+    if args.service_jobs:
+        errs += validate_service_jobs(args.service_jobs)
     for e in errs:
         print(f"validate_trace: {e}", file=sys.stderr)
     if errs:
