@@ -35,7 +35,14 @@ _FLAG_FIELDS = {
     "recover_prob": ("recover_prob", 0.0),
     "max_crashed": ("max_crashed", 0),
     "miss_rate": ("miss_rate", 0.0),
+    "suppress_rate": ("suppress_rate", 0.0),
+    "suppress_window": ("suppress_window", 16),
     "max_delay_rounds": ("max_delay_rounds", 0),
+    "net_model": ("net_model", "flat"),
+    "n_aggregators": ("n_aggregators", 0),
+    "agg_fail_rate": ("agg_fail_rate", 0.0),
+    "agg_stale_rate": ("agg_stale_rate", 0.0),
+    "agg_max_stale": ("agg_max_stale", 1),
     "attack": ("attack", "none"),
     "attack_rate": ("attack_rate", 1.0),
     "attack_target": ("attack_target", 0),
@@ -56,7 +63,10 @@ _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
                "fault_model": str, "drop_rate": float,
                "partition_rate": float, "churn_rate": float,
                "crash_prob": float, "recover_prob": float,
-               "miss_rate": float, "attack": str, "attack_rate": float}
+               "miss_rate": float, "suppress_rate": float,
+               "attack": str, "attack_rate": float,
+               "net_model": str, "agg_fail_rate": float,
+               "agg_stale_rate": float}
 
 # Config fields with NO native-CLI flag (cpp/consensus_sim.cpp): TPU-
 # engine execution/adversary knobs. The native front door still reaches
@@ -87,6 +97,8 @@ def build_parser() -> argparse.ArgumentParser:
             kw["choices"] = ["raft", "pbft", "paxos", "dpos", "hotstuff"]
         if flag == "engine":
             kw["choices"] = ["cpu", "tpu"]
+        if flag == "net_model":
+            kw["choices"] = ["flat", "switch"]
         ap.add_argument("--" + flag.replace("_", "-"), **kw)
     ap.add_argument("--mesh", default=argparse.SUPPRESS,
                     help="device mesh, e.g. '8' (sweep-parallel) or '2x4' "

@@ -82,6 +82,36 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     attack_rate: float = 1.0
     attack_target: int = 0
 
+    # SPEC §9 network model. "flat" = direct peer-to-peer delivery (the
+    # historic model; compiled no-op — the round program is byte-stable
+    # modulo these Config fields). "switch" = in-network vote
+    # aggregation (PAPERS.md 1605.05619): the vote/quorum responses of
+    # raft, raft_sparse, pbft, pbft_bcast, paxos and hotstuff route
+    # through n_aggregators aggregator vertices that combine votes
+    # in-flight (masked sums for counts, max/min for order-statistic
+    # quantities) — receivers see K pre-aggregated values instead of N
+    # messages. Rejected for dpos (the producer row doesn't vote).
+    # Mirrored scalar-for-scalar in cpp/oracle.cpp (AggNet).
+    net_model: str = "flat"
+    n_aggregators: int = 0       # K; switch: 1 <= K <= n_nodes, flat: 0
+    # STREAM_AGG fault axes, per (round, aggregator): an aggregator
+    # fails (its whole segment silently dropped, both directions) with
+    # agg_fail_rate, and serves STALE state with agg_stale_rate — its
+    # uplink re-draws against a shifted round key r - d,
+    # d in [1, agg_max_stale] (a pure re-draw like §A.2 delay; no
+    # queue rides the carry).
+    agg_fail_rate: float = 0.0
+    agg_stale_rate: float = 0.0
+    agg_max_stale: int = 1       # stale depth bound, in [1, 8]
+
+    # SPEC §A.4 correlated DPoS producer suppression (dpos only;
+    # mirrored): one draw per (round // suppress_window, producer), so
+    # a suppressed producer misses EVERY slot inside the window — the
+    # correlated outage iid §A.1 slot-miss keying cannot produce
+    # (RESILIENCE.md §8).
+    suppress_rate: float = 0.0
+    suppress_window: int = 16    # rounds per suppression window (>= 1)
+
     # PBFT.
     f: int = 1                   # byzantine tolerance; n_nodes = 3f+1
     view_timeout: int = 8        # rounds without progress before view change
@@ -200,6 +230,45 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
                 raise ValueError(
                     "attack_rate/attack_target require attack != 'none' "
                     "(SPEC §A.3) — they would be silently ignored")
+        if self.net_model not in ("flat", "switch"):
+            raise ValueError(f"unknown net_model {self.net_model!r} "
+                             "(SPEC §9: flat | switch)")
+        if self.net_model == "switch":
+            if self.protocol == "dpos":
+                raise ValueError(
+                    "net_model='switch' aggregates vote/quorum responses "
+                    "(SPEC §9); dpos's producer row doesn't vote — there "
+                    "is nothing to aggregate, so the model would be a "
+                    "silent no-op")
+            if not (1 <= self.n_aggregators <= self.n_nodes):
+                raise ValueError(
+                    "net_model='switch' requires 1 <= n_aggregators <= "
+                    f"n_nodes, got K={self.n_aggregators} N={self.n_nodes}")
+        else:
+            bad = [n for n, v, d in (
+                ("n_aggregators", self.n_aggregators, 0),
+                ("agg_fail_rate", self.agg_fail_rate, 0.0),
+                ("agg_stale_rate", self.agg_stale_rate, 0.0),
+                ("agg_max_stale", self.agg_max_stale, 1)) if v != d]
+            if bad:
+                raise ValueError(
+                    f"{', '.join(bad)} require net_model='switch' "
+                    "(SPEC §9) — they would be silently ignored")
+        if not (1 <= self.agg_max_stale <= 8):
+            raise ValueError("agg_max_stale must be in [1, 8] (SPEC §9: "
+                             "the stale re-draw is a bounded shift, like "
+                             "the §A.2 delay horizon)")
+        if self.suppress_rate > 0 and self.protocol != "dpos":
+            raise ValueError(
+                "suppress_rate is the SPEC §A.4 correlated DPoS "
+                f"producer-suppression adversary; {self.protocol} has no "
+                "producer schedule and would silently ignore it")
+        if self.suppress_window < 1:
+            raise ValueError("suppress_window must be >= 1")
+        if self.suppress_window != 16 and self.suppress_rate == 0:
+            raise ValueError(
+                "suppress_window requires suppress_rate > 0 (SPEC §A.4) "
+                "— it would be silently ignored")
         if self.t_max <= self.t_min:
             raise ValueError("t_max must exceed t_min")
         if self.max_active < 0:
@@ -261,6 +330,18 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     def attack_cutoff(self) -> int:
         return prob_threshold_u32(self.attack_rate)
 
+    @property
+    def agg_fail_cutoff(self) -> int:
+        return prob_threshold_u32(self.agg_fail_rate)
+
+    @property
+    def agg_stale_cutoff(self) -> int:
+        return prob_threshold_u32(self.agg_stale_rate)
+
+    @property
+    def suppress_cutoff(self) -> int:
+        return prob_threshold_u32(self.suppress_rate)
+
     # Static adversary GATES — the Python-level on/off facts the engines
     # branch on while tracing (the cutoff VALUES only ever feed jnp
     # compares). Engines must read these instead of comparing cutoffs
@@ -280,6 +361,24 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     def no_partition(self) -> bool:
         return self.partition_cutoff == 0
 
+    @property
+    def switch_on(self) -> bool:
+        """SPEC §9 static gate: flat configs must compile the historic
+        round program byte-for-byte (tests/test_aggregate.py)."""
+        return self.net_model == "switch"
+
+    @property
+    def agg_fail_on(self) -> bool:
+        return self.agg_fail_cutoff > 0
+
+    @property
+    def agg_stale_on(self) -> bool:
+        return self.agg_stale_cutoff > 0
+
+    @property
+    def suppress_on(self) -> bool:
+        return self.suppress_cutoff > 0
+
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
         d["mesh_shape"] = list(self.mesh_shape)
@@ -291,6 +390,9 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             "recover": self.recover_cutoff,
             "miss": self.miss_cutoff,
             "attack": self.attack_cutoff,
+            "agg_fail": self.agg_fail_cutoff,
+            "agg_stale": self.agg_stale_cutoff,
+            "suppress": self.suppress_cutoff,
         }
         return json.dumps(d, indent=2)
 

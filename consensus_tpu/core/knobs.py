@@ -40,7 +40,7 @@ from .config import Config
 # attack_target (a node id, also u32 on device).
 KNOB_COLUMNS = ("drop_cutoff", "partition_cutoff", "churn_cutoff",
                 "crash_cutoff", "recover_cutoff", "miss_cutoff",
-                "attack_cutoff", "attack_target")
+                "suppress_cutoff", "attack_cutoff", "attack_target")
 
 
 class KnobView:

@@ -60,6 +60,20 @@ STREAM_CRASH = np.uint32(0x68E31DA5)    # per (round, node) crash/recover draw
 STREAM_SLOTMISS = np.uint32(0x7F4A7C15)  # per (round, producer) DPoS slot miss
 STREAM_DELAY = np.uint32(0x2545F491)     # per (origin round, d, edge) retransmit
 STREAM_ATTACK = np.uint32(0xBB67AE85)    # per round targeted-attack activation
+# SPEC §9 in-network vote aggregation (net_model="switch"): the
+# per-(round, aggregator) fault axes of the programmable-switch model —
+# c0 selects the subdraw: 0 = aggregator failure (a down aggregator
+# silently drops its whole segment), 1 = stale-serve activation (the
+# aggregator re-serves the segment it combined from a shifted round's
+# delivery pattern — a pure re-draw, §A.2-style, no queue rides the
+# carry), 2 = the stale depth draw d in [1, agg_max_stale]. Mirrored.
+STREAM_AGG = np.uint32(0x510E527F)       # per (round, subdraw, aggregator)
+# SPEC §A.4 correlated DPoS producer suppression: one draw per
+# (window, producer) with window = round // suppress_window, so a
+# suppressed producer misses EVERY slot scheduled inside the window —
+# the targeted (correlated) stream RESILIENCE.md §8 records iid
+# slot-miss keying cannot emulate. dpos only; mirrored.
+STREAM_SUPPRESS = np.uint32(0x1F83D9AB)  # per (window, subdraw, producer)
 # Host-side adversary-search orchestration (tools/advsearch): candidate
 # sampling, mutation and eval-seed draws. Never drawn on device or in
 # the oracle — registered so search runs replay exactly from one seed
@@ -91,6 +105,8 @@ STREAM_KEYS = {
     "STREAM_SLOTMISS": ("round", "subdraw", "producer"),  # c0: 0 (reserved)
     "STREAM_DELAY": ("origin_round", "delay", "edge"),  # via the §A.2 mixer
     "STREAM_ATTACK": ("round", None, None),
+    "STREAM_AGG": ("round", "subdraw", "aggregator"),  # c0: 0=fail 1=stale 2=depth
+    "STREAM_SUPPRESS": ("window", "subdraw", "producer"),  # c0: 0 (reserved)
     "STREAM_SEARCH": ("generation", "subdraw", "index"),
 }
 

@@ -101,6 +101,7 @@ DPOS_TELEMETRY = ("blocks_appended",     # validator-chain extensions
                   "producer_rotations",  # slot handoffs p_{r-1} != p_r
                   "churn_slots",         # rounds churned (no block)
                   "missed_slots",        # SPEC §A.1 per-producer slot miss
+                  "suppressed_slots",    # SPEC §A.4 correlated suppression
                   ) + CRASH_TELEMETRY    # SPEC §6c (zeros when disabled)
 
 # Flight-recorder latency histogram (docs/OBSERVABILITY.md §"Flight
@@ -144,11 +145,29 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
         from ..ops.adversary import slot_missed
         miss = slot_missed(seed, r, p, cfg.miss_cutoff)
 
+    # SPEC §A.4 correlated producer suppression: ONE draw per
+    # (round // suppress_window, producer) — the window keying is the
+    # point: a suppressed producer misses EVERY slot it is scheduled
+    # for inside the window, so it vanishes from the distinct-producer
+    # suffix for suppress_window rounds at a stretch and LIB stalls —
+    # the targeted stream RESILIENCE.md §8's negative iid result asked
+    # for. suppress_cutoff == 0 is a static no-op.
+    suppress_on = cfg.suppress_on
+    if suppress_on:
+        suppressed = _draw(
+            seed, rng.STREAM_SUPPRESS,
+            (jnp.asarray(r, jnp.uint32)
+             // jnp.uint32(cfg.suppress_window)), 0,
+            jnp.asarray(p, jnp.int32).astype(jnp.uint32)) \
+            < _lt(cfg.suppress_cutoff)
+
     recv = _producer_delivery(cfg, seed, r, p)
     recv = recv | (jnp.arange(V, dtype=jnp.int32) == p)   # self-append
     append = recv & ~churn & (st.chain_len < L)
     if miss_on:
         append = append & ~miss
+    if suppress_on:
+        append = append & ~suppressed
     if crash_on:
         append = append & ~down & ~down[p]
 
@@ -167,9 +186,10 @@ def dpos_round(cfg: Config, producers, st: DposState, r, *,
     n_app = jnp.sum(append.astype(jnp.int32))
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
     missed = miss.astype(jnp.int32) if miss_on else jnp.int32(0)
+    suppr = suppressed.astype(jnp.int32) if suppress_on else jnp.int32(0)
     vec = jnp.stack([n_app, jnp.int32(V) - n_app,
                      ((r > 0) & (p != p_prev)).astype(jnp.int32),
-                     churn.astype(jnp.int32), missed, *cz])
+                     churn.astype(jnp.int32), missed, suppr, *cz])
     if not flight:
         return new, vec
     from ..ops.flight import bucket_counts

@@ -59,6 +59,8 @@ from ..ops.adversary import (CRASH_TELEMETRY, bitcast_i32, crash_counts,
                              crash_transition, delayed_open, freeze_down)
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import draw as _draw
+from ..ops.aggregate import (AGG_TELEMETRY, agg_counts, agg_ids, agg_round,
+                             downlink, seg_sum, uplink_edge)
 from ..ops.flight import bucket_counts
 
 
@@ -124,7 +126,8 @@ HOTSTUFF_TELEMETRY = ("qc_formed",            # rounds forming a QC (0/1)
                       "view_changes",         # timeout-driven advances
                       "proposals_delivered",  # Σ receivers of the round
                       "votes_counted",        # votes the leader counted
-                      ) + CRASH_TELEMETRY     # SPEC §6c (zeros when off)
+                      ) + CRASH_TELEMETRY \
+                      + AGG_TELEMETRY         # SPEC §9 (zeros when flat)
 
 # Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
 # recorder"):
@@ -188,16 +191,19 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
     if crash_on:
         proposing &= ~down[L]
 
+    switch = cfg.switch_on
     open_p = ~(rng.delivery_u32_jnp(seed, ur, uL, uidx)
-               < _lt(cfg.drop_cutoff))
-    open_v = ~(rng.delivery_u32_jnp(seed, ur, uidx, uL)
                < _lt(cfg.drop_cutoff))
     if cfg.max_delay_rounds > 0:
         # SPEC §A.2 delayed retransmission, on the same absolute keys.
         open_p |= delayed_open(seed, ur, uL, uidx, cfg.drop_cutoff,
                                cfg.max_delay_rounds)
-        open_v |= delayed_open(seed, ur, uidx, uL, cfg.drop_cutoff,
-                               cfg.max_delay_rounds)
+    if not switch:
+        open_v = ~(rng.delivery_u32_jnp(seed, ur, uidx, uL)
+                   < _lt(cfg.drop_cutoff))
+        if cfg.max_delay_rounds > 0:
+            open_v |= delayed_open(seed, ur, uidx, uL, cfg.drop_cutoff,
+                                   cfg.max_delay_rounds)
     part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
                    < _lt(cfg.partition_cutoff))
     side = _draw(seed, rng.STREAM_PARTITION, ur, 1, uidx) & jnp.uint32(1)
@@ -213,10 +219,25 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
     # withhold. The leader's threshold check is ONE count — the whole
     # linear-communication point. (Given pdel, the partition side check
     # on the return edge is the identical predicate — a same-side pair
-    # stays same-side within the round.)
+    # stays same-side within the round.) Under net_model="switch"
+    # (SPEC §9) the votes route through the K aggregators instead: the
+    # leader sees K pre-aggregated segment counts, and the STREAM_AGG
+    # fault axes (a down aggregator drops its whole vote segment; a
+    # stale one re-serves a shifted round's delivery pattern) become
+    # view-liveness attacks.
     vote = pdel & honest
-    vdel = vote & ((idx == L) | open_v)
-    cnt = jnp.sum(vdel.astype(jnp.int32))
+    if switch:
+        aggst = agg_round(cfg, seed, ur)
+        sids = agg_ids(N, cfg.n_aggregators)
+        up0 = uplink_edge(cfg, seed, aggst, 0)
+        contrib = vote & (idx != L) & up0
+        seg = seg_sum(contrib.astype(jnp.int32), sids, cfg.n_aggregators)
+        down0 = downlink(cfg, seed, ur, aggst, 0, jnp.reshape(L, (1,)))
+        cnt = (vote[L].astype(jnp.int32)
+               + jnp.sum(jnp.where(down0[:, 0], seg, 0)))
+    else:
+        vdel = vote & ((idx == L) | open_v)
+        cnt = jnp.sum(vdel.astype(jnp.int32))
     qc = proposing & (cnt >= Q)
 
     # ---- P3 QC-chain shift + chained 3-chain commit: the new QC is
@@ -262,12 +283,13 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
     if not telem:
         return new
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    az = agg_counts(aggst) if switch else agg_counts()
     vec = jnp.stack([qc.astype(jnp.int32),
                      gcommit - st.gcommit,
                      jnp.sum(new.clen - st.clen),
                      to.astype(jnp.int32),
                      jnp.sum(pdel.astype(jnp.int32)),
-                     cnt, *cz])
+                     cnt, *cz, *az])
     if not flight:
         return new, vec
     lat = jnp.stack([

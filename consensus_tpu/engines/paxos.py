@@ -26,6 +26,7 @@ from ..core import rng
 from ..core.config import Config
 from ..ops.adversary import (CRASH_TELEMETRY, crash_counts,
                              crash_transition, freeze_down)
+from ..ops.aggregate import AGG_TELEMETRY, agg_counts
 from .raft import _delivery, _draw, _i32, _lt  # shared SPEC §2 adversary
 
 
@@ -78,7 +79,8 @@ PAXOS_TELEMETRY = ("promises",           # promise responses delivered
                    "accepts",            # accepted responses delivered
                    "proposals_decided",  # proposers reaching majority
                    "values_learned",     # (node, slot) newly learned
-                   ) + CRASH_TELEMETRY   # SPEC §6c (zeros when disabled)
+                   ) + CRASH_TELEMETRY \
+                   + AGG_TELEMETRY       # SPEC §9 (zeros when flat)
 
 # Flight-recorder latency histogram (docs/OBSERVABILITY.md §"Flight
 # recorder"): rounds_to_learn — at each newly learned (node, slot),
@@ -148,10 +150,34 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False,
     # the BASELINE.json:10 10k x 10k shape) before gathering.
     po = promised0[:, slot_p]                                           # [A, P]
     npo = new_promised[:, slot_p]
-    prom = (is_prop[None, :] & prep_del & resp_del
-            & (ballot[None, :] > po) & (ballot[None, :] == npo))        # [A, P]
+    switch = cfg.switch_on
+    if switch:
+        # SPEC §9: the promise responses route through the K
+        # aggregators (phase 0) — proposers see K pre-aggregated
+        # segment counts instead of A per-acceptor responses; the
+        # promise-carried accepted value is the switch's max/min
+        # order-statistic combine (max ballot, lowest-id tie-break —
+        # identical to the flat argmax), read off the two-hop mask.
+        from ..ops.aggregate import (agg_ids, agg_round, downlink,
+                                     seg_sum, take_seg, uplink_edge)
+        K_agg = cfg.n_aggregators
+        aggst = agg_round(cfg, seed, ur)
+        sids = agg_ids(N, K_agg)
+        up0 = uplink_edge(cfg, seed, aggst, 0)
+        if crash_on:
+            up0 &= up
+        prom_c = (is_prop[None, :] & prep_del
+                  & (ballot[None, :] > po) & (ballot[None, :] == npo)
+                  & up0[:, None])                                       # [A, P]
+        down0 = downlink(cfg, seed, ur, aggst, 0, idx)                  # [K, P]
+        seg_prom = seg_sum(prom_c.astype(jnp.int32), sids, K_agg)       # [K, P]
+        n_prom = jnp.sum(jnp.where(down0, seg_prom, 0), axis=0)
+        prom = prom_c & take_seg(down0, sids, K_agg)    # delivered [A, P]
+    else:
+        prom = (is_prop[None, :] & prep_del & resp_del
+                & (ballot[None, :] > po) & (ballot[None, :] == npo))    # [A, P]
+        n_prom = jnp.sum(prom, axis=0, dtype=jnp.int32)
     rep_bal = jnp.where(prom, st.acc_bal[:, slot_p], 0)
-    n_prom = jnp.sum(prom, axis=0, dtype=jnp.int32)
     best_a = jnp.argmax(rep_bal, axis=0).astype(jnp.int32)  # first max ⇒ lowest id
     best_bal = jnp.max(rep_bal, axis=0)
     rep_val = st.acc_val[best_a, slot_p]                                # [P]
@@ -178,9 +204,20 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False,
     acc_val2 = jnp.where(has_acc, val_w, st.acc_val)
     promised2 = jnp.where(has_acc, a_max, new_promised)
 
-    # Phase 5: accepted responses → decide.
-    accd = win & resp_del
-    n_acc = jnp.sum(accd, axis=0, dtype=jnp.int32)
+    # Phase 5: accepted responses → decide. Switch: phase-1 two-hop,
+    # segment-summed per proposer (SPEC §9).
+    if switch:
+        up1 = uplink_edge(cfg, seed, aggst, 1)
+        if crash_on:
+            up1 &= up
+        acc_c = win & up1[:, None]
+        down1 = downlink(cfg, seed, ur, aggst, 1, idx)                  # [K, P]
+        seg_acc = seg_sum(acc_c.astype(jnp.int32), sids, K_agg)
+        n_acc = jnp.sum(jnp.where(down1, seg_acc, 0), axis=0)
+        accd = acc_c & take_seg(down1, sids, K_agg)  # telemetry mask
+    else:
+        accd = win & resp_del
+        n_acc = jnp.sum(accd, axis=0, dtype=jnp.int32)
     decided = proceed & (n_acc >= majority)
 
     # Phase 6: decide broadcast; learn from lowest-id decider, first
@@ -215,9 +252,10 @@ def paxos_round(cfg: Config, st: PaxosState, r, *, telem: bool = False,
         return new
     cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    az = agg_counts(aggst) if switch else agg_counts()
     nack = is_prop[None, :] & prep_del & resp_del & ~prom
     vec = jnp.stack([cnt(prom), cnt(nack), cnt(accd), cnt(decided),
-                     cnt(learn_now), *cz])
+                     cnt(learn_now), *cz, *az])
     if not flight:
         return new, vec
     from ..ops.flight import bucket_counts

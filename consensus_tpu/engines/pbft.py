@@ -22,6 +22,7 @@ from ..core import rng
 from ..core.config import Config
 from ..ops.adversary import (CRASH_TELEMETRY, crash_counts,
                              crash_transition, freeze_down)
+from ..ops.aggregate import AGG_TELEMETRY, agg_counts
 from .raft import _delivery, _draw, _i32, _lt
 
 
@@ -125,7 +126,8 @@ PBFT_TELEMETRY = ("prepare_quorums",   # (node, slot) newly prepared
                   "commit_missed",     # prepared, uncommitted, tally < Q
                   "commits_adopted",   # committed via decide gossip
                   "view_changes",      # Σ per-node view advance
-                  ) + CRASH_TELEMETRY  # SPEC §6c (zeros when disabled)
+                  ) + CRASH_TELEMETRY \
+                  + AGG_TELEMETRY      # SPEC §9 (zeros when flat)
 
 # Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
 # recorder"; shared with the §6b bcast kernel):
@@ -246,37 +248,89 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     pp_val = jnp.where(accept, pm_val, pp_val)
     pp_seen = pp_seen | accept
 
-    # ---- P4 prepare tally (value-matched, incl. self).
-    val_eq = pp_val[:, None, :] == pp_val[None, :, :]                  # [i, j, s]
-    pcount = jnp.sum(d_self_h[:, :, None] & pp_seen[:, None, :] & val_eq,
-                     axis=0, dtype=jnp.int32)                          # [j, s]
-    if equiv:
-        # Byz i claims support for exactly j's value iff sup[i, j] —
-        # value-independent, so one [j] count serves every slot.
-        extra = jnp.sum(deliver & byz[:, None] & sup, axis=0,
-                        dtype=jnp.int32)                               # [j]
-        pcount = pcount + extra[:, None]
+    # ---- P4 prepare tally (value-matched, incl. self). Under
+    # net_model="switch" (SPEC §9) the votes route through the K
+    # aggregators: each combines its segment into (count, vmax, vmin)
+    # and serves (count, value) only for value-UNIFORM segments;
+    # receivers total the delivered serving segments matching their own
+    # value, plus their local self vote. Equivocating support collapses
+    # to the per-ROUND stance (the §6b draw — the switch dedups
+    # per-receiver claims).
+    switch = cfg.switch_on
+    if switch:
+        from ..ops.aggregate import (agg_ids, agg_round, downlink,
+                                     downlink_self, min_id_votes,
+                                     uplink_edge, value_votes)
+        K_agg = cfg.n_aggregators
+        aggst = agg_round(cfg, seed, ur)
+        sids = agg_ids(N, K_agg)
+        if equiv:
+            stance = (_draw(seed, rng.STREAM_EQUIV, ur,
+                            idx.astype(jnp.uint32),
+                            jnp.uint32(0x80000000))
+                      & jnp.uint32(1)).astype(bool)
+        up0 = uplink_edge(cfg, seed, aggst, 0)
+        if crash_on:
+            up0 &= up
+        down0 = downlink(cfg, seed, ur, aggst, 0, idx)
+        dn0 = downlink_self(cfg, seed, ur, aggst, 0)
+        c4 = value_votes(pp_val, honest[:, None] & pp_seen, up0, down0,
+                         dn0, sids, K_agg,
+                         eq_up=(byz & stance & up0) if equiv else None)
+        pcount = c4 + (honest[:, None] & pp_seen).astype(jnp.int32)
+    else:
+        val_eq = pp_val[:, None, :] == pp_val[None, :, :]              # [i, j, s]
+        pcount = jnp.sum(d_self_h[:, :, None] & pp_seen[:, None, :] & val_eq,
+                         axis=0, dtype=jnp.int32)                      # [j, s]
+        if equiv:
+            # Byz i claims support for exactly j's value iff sup[i, j] —
+            # value-independent, so one [j] count serves every slot.
+            extra = jnp.sum(deliver & byz[:, None] & sup, axis=0,
+                            dtype=jnp.int32)                           # [j]
+            pcount = pcount + extra[:, None]
     prep_hit = pp_seen & (pcount >= Q)
     prep_new = prep_hit & ~prepared        # telemetry (DCE'd when off)
     prep_miss = pp_seen & ~prepared & ~prep_hit
     prepared = prepared | prep_hit
 
-    # ---- P5 commit tally.
-    ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
-                     axis=0, dtype=jnp.int32)
-    if equiv:
-        ccount = ccount + extra[:, None]
+    # ---- P5 commit tally (switch: phase-1 two-hop, same combine).
+    if switch:
+        up1 = uplink_edge(cfg, seed, aggst, 1)
+        if crash_on:
+            up1 &= up
+        down1 = downlink(cfg, seed, ur, aggst, 1, idx)
+        dn1 = downlink_self(cfg, seed, ur, aggst, 1)
+        c5 = value_votes(pp_val, honest[:, None] & prepared, up1, down1,
+                         dn1, sids, K_agg,
+                         eq_up=(byz & stance & up1) if equiv else None)
+        ccount = c5 + (honest[:, None] & prepared).astype(jnp.int32)
+    else:
+        ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
+                         axis=0, dtype=jnp.int32)
+        if equiv:
+            ccount = ccount + extra[:, None]
     commit_now = prepared & (ccount >= Q) & ~committed
     commit_miss = prepared & ~committed & (ccount < Q)  # telemetry
     dval = jnp.where(commit_now, pp_val, dval)
     committed = committed | commit_now
 
-    # ---- P6 decide gossip: adopt from lowest-id delivered decider.
+    # ---- P6 decide gossip: adopt from lowest-id delivered decider
+    # (switch: each aggregator serves its segment's min deciding id +
+    # that decider's value — the order-statistic combine, phase 2).
     dec_b = committed & honest[:, None]
-    imin = jnp.min(jnp.where(d_h[:, :, None] & dec_b[:, None, :],
-                             idx[:, None, None], N), axis=0)           # [j, s]
-    adopt = (imin < N) & ~committed
-    dval = jnp.where(adopt, _adopt_val(d_h, dec_b, imin, dval), dval)
+    if switch:
+        up2 = uplink_edge(cfg, seed, aggst, 2)
+        if crash_on:
+            up2 &= up
+        down2 = downlink(cfg, seed, ur, aggst, 2, idx)
+        imin, vad = min_id_votes(dec_b, dval, up2, down2, sids, K_agg, N)
+        adopt = (imin < N) & ~committed
+        dval = jnp.where(adopt, vad, dval)
+    else:
+        imin = jnp.min(jnp.where(d_h[:, :, None] & dec_b[:, None, :],
+                                 idx[:, None, None], N), axis=0)       # [j, s]
+        adopt = (imin < N) & ~committed
+        dval = jnp.where(adopt, _adopt_val(d_h, dec_b, imin, dval), dval)
     committed = committed | adopt
 
     # ---- P7 timer.
@@ -301,9 +355,10 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     # node's view to 0, and a raw sum would let that cancel real
     # advances (identical to the plain delta when crashes are off —
     # views never decrease otherwise).
+    az = agg_counts(aggst) if switch else agg_counts()
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
-                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az])
     if not flight:
         return new, vec
     from ..ops.flight import bucket_counts

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from ..core import rng
 from ..core.config import Config
 from ..ops.adversary import crash_counts, crash_transition, freeze_down
+from ..ops.aggregate import agg_counts
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import bitcast_i32 as _i32
@@ -524,16 +525,54 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     pp_val = jnp.where(accept, pm_val, pp_val)
     pp_seen = pp_seen | accept
 
-    # ---- P4 + P5 tallies: one payload sort, per-(slot, side) top-M
-    # run tables, elementwise delivery (:func:`_aggregate_tallies` —
-    # shared with the padded traced-f ladder round).
-    prep_hit, prepared2, commit_now, c5 = _aggregate_tallies(
-        pp_val, pp_seen, prepared, committed, honest, bcast, Q,
-        _table_width(N, f, cfg.n_byzantine if equiv else 0),
-        side=None if no_part else side,
-        part_active=None if no_part else part_active,
-        eq_send=(byz & bcast & stance) if equiv else None,
-        up=up if crash_on else None)
+    # ---- P4 + P5 tallies. net_model="flat": one payload sort,
+    # per-(slot, side) top-M run tables, elementwise delivery
+    # (:func:`_aggregate_tallies` — shared with the padded traced-f
+    # ladder round). net_model="switch" (SPEC §9): the round's ONE
+    # atomic broadcast lands on the sender's aggregator (uplink at the
+    # aggregator's effective — possibly STALE — round) and each
+    # aggregator combines its segment into (count, vmax, vmin), serving
+    # value-uniform segments only; receivers total K pre-aggregated
+    # values instead of running the sorted-space machinery at all — the
+    # switch round carries ZERO sort-class and ZERO cumsum-class ops
+    # (the tightened `pbft-100k-bcast-switch` hlocheck ceiling).
+    switch = cfg.switch_on
+    if switch:
+        from ..ops.aggregate import (agg_ids, agg_round, downlink,
+                                     downlink_self, min_id_votes,
+                                     uplink_bcast, value_votes)
+        K_agg = cfg.n_aggregators
+        aggst = agg_round(cfg, seed, ur)
+        sids = agg_ids(N, K_agg)
+        upb = uplink_bcast(cfg, seed, aggst)
+        if crash_on:
+            upb &= up
+        eq_up = (byz & stance & upb) if equiv else None
+        down0 = downlink(cfg, seed, ur, aggst, 0, idx)
+        dn0 = downlink_self(cfg, seed, ur, aggst, 0)
+        c4 = value_votes(pp_val, honest[:, None] & pp_seen, upb, down0,
+                         dn0, sids, K_agg, eq_up=eq_up)
+        pcount = c4 + (honest[:, None] & pp_seen).astype(jnp.int32)
+        prep_hit = pp_seen & (pcount >= Q)
+        if crash_on:
+            prep_hit &= up[:, None]
+        prepared2 = prepared | prep_hit
+        down1 = downlink(cfg, seed, ur, aggst, 1, idx)
+        dn1 = downlink_self(cfg, seed, ur, aggst, 1)
+        c5 = (value_votes(pp_val, honest[:, None] & prepared2, upb,
+                          down1, dn1, sids, K_agg, eq_up=eq_up)
+              + (honest[:, None] & prepared2).astype(jnp.int32))
+        commit_now = prepared2 & (c5 >= Q) & ~committed
+        if crash_on:
+            commit_now &= up[:, None]
+    else:
+        prep_hit, prepared2, commit_now, c5 = _aggregate_tallies(
+            pp_val, pp_seen, prepared, committed, honest, bcast, Q,
+            _table_width(N, f, cfg.n_byzantine if equiv else 0),
+            side=None if no_part else side,
+            part_active=None if no_part else part_active,
+            eq_send=(byz & bcast & stance) if equiv else None,
+            up=up if crash_on else None)
     prep_new = prep_hit & ~prepared        # telemetry (DCE'd when off)
     prep_miss = pp_seen & ~prepared & ~prep_hit
     prepared = prepared2
@@ -541,33 +580,47 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     dval = jnp.where(commit_now, pp_val, dval)
     committed = committed | commit_now
 
-    # ---- P6 decide gossip: lowest-id broadcasting decider per side.
-    # The decider — hence the adopted value — varies only per
-    # (partition side, slot): gather the ≤2 candidate rows (O(S)
-    # elements) and select per receiver, NEVER a [N, S] arbitrary-index
-    # gather of those same values (that gather ran on the serial unit
-    # and was 66% of the 8-sweep 100k program; docs/PERF.md).
-    dec = honest[:, None] & bcast[:, None] & committed            # [N, S]
-    if no_part:
-        src = jnp.where(dec, idx[:, None], N)
-        imin_rows = jnp.min(src, axis=0)[None, :]                 # [1, S]
-        imin = jnp.broadcast_to(imin_rows, (N, S))
+    # ---- P6 decide gossip: lowest-id broadcasting decider per side
+    # (flat) or per aggregator segment (switch — the min/value
+    # order-statistic combine, phase 2 downlink).
+    if switch:
+        down2 = downlink(cfg, seed, ur, aggst, 2, idx)
+        dec_sw = honest[:, None] & committed
+        imin_sw, vad = min_id_votes(dec_sw, dval, upb, down2, sids,
+                                    K_agg, N)
+        adopt = (imin_sw < N) & ~committed
+        if crash_on:
+            adopt &= up[:, None]   # down receivers adopt nothing
+        dval = jnp.where(adopt, vad, dval)
+        committed = committed | adopt
     else:
-        rows = []
-        for b in (0, 1):
-            src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
-            rows.append(jnp.min(src, axis=0))                     # [S]
-        imin_rows = jnp.stack(rows)                               # [2, S]
-        imin = imin_rows[side]                                    # [N, S]
-    adopt = (imin < N) & ~committed
-    if crash_on:
-        adopt &= up[:, None]   # down receivers adopt nothing (SPEC §6c)
-    val_rows = dval[jnp.clip(imin_rows, 0, N - 1),
-                    sarange[None, :]]                             # [1|2, S]
-    vfull = (jnp.broadcast_to(val_rows, (N, S)) if no_part
-             else val_rows[side])
-    dval = jnp.where(adopt, vfull, dval)
-    committed = committed | adopt
+        # The decider — hence the adopted value — varies only per
+        # (partition side, slot): gather the ≤2 candidate rows (O(S)
+        # elements) and select per receiver, NEVER a [N, S]
+        # arbitrary-index gather of those same values (that gather ran
+        # on the serial unit and was 66% of the 8-sweep 100k program;
+        # docs/PERF.md).
+        dec = honest[:, None] & bcast[:, None] & committed        # [N, S]
+        if no_part:
+            src = jnp.where(dec, idx[:, None], N)
+            imin_rows = jnp.min(src, axis=0)[None, :]             # [1, S]
+            imin = jnp.broadcast_to(imin_rows, (N, S))
+        else:
+            rows = []
+            for b in (0, 1):
+                src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
+                rows.append(jnp.min(src, axis=0))                 # [S]
+            imin_rows = jnp.stack(rows)                           # [2, S]
+            imin = imin_rows[side]                                # [N, S]
+        adopt = (imin < N) & ~committed
+        if crash_on:
+            adopt &= up[:, None]  # down receivers adopt nothing (§6c)
+        val_rows = dval[jnp.clip(imin_rows, 0, N - 1),
+                        sarange[None, :]]                         # [1|2, S]
+        vfull = (jnp.broadcast_to(val_rows, (N, S)) if no_part
+                 else val_rows[side])
+        dval = jnp.where(adopt, vfull, dval)
+        committed = committed | adopt
 
     # ---- P7 timer.
     new_commit = jnp.any(committed & ~committed_at_start, axis=1)
@@ -589,11 +642,12 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         return new
     cnt = lambda mk: jnp.sum(mk.astype(jnp.int32))  # noqa: E731
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    az = agg_counts(aggst) if switch else agg_counts()
     # view_changes clips at 0 like the dense kernel: a §6c recovery
     # resets the view, and the raw delta would cancel real advances.
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
-                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az])
     if not flight:
         return new, vec
     # Same PBFT_LATENCY semantics as the dense §6 kernel (the fault

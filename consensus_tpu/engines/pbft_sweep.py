@@ -57,6 +57,69 @@ from .pbft_bcast import (_aggregate_tallies, _kth_largest, _table_width,
                          view_bound)
 
 
+def _padded_switch_phases(cfg: Config, seed, ur, n_real, honest,
+                          pp_seen, pp_val, prepared, committed, dval, Q,
+                          *, byz, bcast_uplink: bool):
+    """The SPEC §9 switch P4/P5/P6 on a padded population with TRACED
+    per-lane (n_real, Q): segmentation B = ceil(n_real/K) and the
+    aggregator vertex base are the lane's true n_real, so every draw
+    key matches the standalone switch run at that rung byte-for-byte
+    (per-rung equivalence, tests/test_aggregate.py). ``byz`` is None
+    without equivocators. Shared by both padded rounds — ``bcast_uplink``
+    selects the §6b one-broadcast-per-round uplink vs the edge model's
+    per-phase uplinks. Crash (§6c) is rejected upstream by the ladder."""
+    from ..ops.aggregate import (agg_round, downlink, downlink_self,
+                                 min_id_votes, uplink_bcast, uplink_edge,
+                                 value_votes)
+    N = cfg.n_nodes                      # N_pad (static)
+    K = cfg.n_aggregators
+    idx = jnp.arange(N, dtype=jnp.int32)
+    sids = jnp.minimum(idx // ((n_real + K - 1) // K), K - 1)
+    aggst = agg_round(cfg, seed, ur)
+    equiv = byz is not None
+    if equiv:
+        stance = (_draw(seed, rng.STREAM_EQUIV, ur, idx.astype(jnp.uint32),
+                        jnp.uint32(0x80000000)) & jnp.uint32(1)).astype(bool)
+
+    def up_ph(ph: int):
+        if bcast_uplink:
+            return uplink_bcast(cfg, seed, aggst, seg_ids=sids,
+                                n_vert=n_real, traced=True)
+        return uplink_edge(cfg, seed, aggst, ph, seg_ids=sids,
+                           n_vert=n_real, traced=True)
+
+    upb = up_ph(0)
+    up0, up1, up2 = (upb, upb, upb) if bcast_uplink \
+        else (upb, up_ph(1), up_ph(2))
+    down0 = downlink(cfg, seed, ur, aggst, 0, idx, n_vert=n_real)
+    dn0 = downlink_self(cfg, seed, ur, aggst, 0, seg_ids=sids,
+                        n_vert=n_real)
+    c4 = value_votes(pp_val, honest[:, None] & pp_seen, up0, down0, dn0,
+                     sids, K, eq_up=(byz & stance & up0) if equiv else None,
+                     traced=True)
+    pcount = c4 + (honest[:, None] & pp_seen).astype(jnp.int32)
+    prepared = prepared | (pp_seen & (pcount >= Q))
+    down1 = downlink(cfg, seed, ur, aggst, 1, idx, n_vert=n_real)
+    dn1 = downlink_self(cfg, seed, ur, aggst, 1, seg_ids=sids,
+                        n_vert=n_real)
+    c5 = (value_votes(pp_val, honest[:, None] & prepared, up1, down1, dn1,
+                      sids, K,
+                      eq_up=(byz & stance & up1) if equiv else None,
+                      traced=True)
+          + (honest[:, None] & prepared).astype(jnp.int32))
+    commit_now = prepared & (c5 >= Q) & ~committed
+    dval = jnp.where(commit_now, pp_val, dval)
+    committed = committed | commit_now
+    down2 = downlink(cfg, seed, ur, aggst, 2, idx, n_vert=n_real)
+    dec = honest[:, None] & committed
+    imin, vad = min_id_votes(dec, dval, up2, down2, sids, K, N,
+                             traced=True)
+    adopt = (imin < N) & ~committed
+    dval = jnp.where(adopt, vad, dval)
+    committed = committed | adopt
+    return prepared, committed, dval
+
+
 def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     """One SPEC §6 round on a padded population.
 
@@ -150,32 +213,44 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     pp_val = jnp.where(accept, pm_val, pp_val)
     pp_seen = pp_seen | accept
 
-    # ---- P4 prepare tally (value-matched, incl. self).
-    val_eq = pp_val[:, None, :] == pp_val[None, :, :]
-    pcount = jnp.sum(d_self_h[:, :, None] & pp_seen[:, None, :] & val_eq,
-                     axis=0, dtype=jnp.int32)
-    if equiv:
-        extra = jnp.sum(deliver & byz[:, None] & sup, axis=0,
-                        dtype=jnp.int32)
-        pcount = pcount + extra[:, None]
-    prepared = prepared | (pp_seen & (pcount >= Q))
+    # ---- P4/P5/P6 — flat per-receiver tallies, or the SPEC §9 switch
+    # combine with TRACED segmentation (B = ceil(n_real/K) is per-lane,
+    # so segment reduces go through jax.ops.segment_* instead of the
+    # static reshape; draws are keyed on the lane's true n_real, making
+    # each rung byte-equal to its standalone switch run).
+    switch = cfg.switch_on
+    if switch:
+        prepared, committed, dval = _padded_switch_phases(
+            cfg, seed, ur, n_real, honest,
+            pp_seen, pp_val, prepared, committed, dval, Q,
+            byz=byz if equiv else None, bcast_uplink=False)
+    else:
+        # ---- P4 prepare tally (value-matched, incl. self).
+        val_eq = pp_val[:, None, :] == pp_val[None, :, :]
+        pcount = jnp.sum(d_self_h[:, :, None] & pp_seen[:, None, :] & val_eq,
+                         axis=0, dtype=jnp.int32)
+        if equiv:
+            extra = jnp.sum(deliver & byz[:, None] & sup, axis=0,
+                            dtype=jnp.int32)
+            pcount = pcount + extra[:, None]
+        prepared = prepared | (pp_seen & (pcount >= Q))
 
-    # ---- P5 commit tally.
-    ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
-                     axis=0, dtype=jnp.int32)
-    if equiv:
-        ccount = ccount + extra[:, None]
-    commit_now = prepared & (ccount >= Q) & ~committed
-    dval = jnp.where(commit_now, pp_val, dval)
-    committed = committed | commit_now
+        # ---- P5 commit tally.
+        ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
+                         axis=0, dtype=jnp.int32)
+        if equiv:
+            ccount = ccount + extra[:, None]
+        commit_now = prepared & (ccount >= Q) & ~committed
+        dval = jnp.where(commit_now, pp_val, dval)
+        committed = committed | commit_now
 
-    # ---- P6 decide gossip: adopt from lowest-id delivered decider.
-    dec_b = committed & honest[:, None]
-    imin = jnp.min(jnp.where(d_h[:, :, None] & dec_b[:, None, :],
-                             idx[:, None, None], N), axis=0)
-    adopt = (imin < N) & ~committed
-    dval = jnp.where(adopt, _adopt_val(d_h, dec_b, imin, dval), dval)
-    committed = committed | adopt
+        # ---- P6 decide gossip: adopt from lowest-id delivered decider.
+        dec_b = committed & honest[:, None]
+        imin = jnp.min(jnp.where(d_h[:, :, None] & dec_b[:, None, :],
+                                 idx[:, None, None], N), axis=0)
+        adopt = (imin < N) & ~committed
+        dval = jnp.where(adopt, _adopt_val(d_h, dec_b, imin, dval), dval)
+        committed = committed | adopt
 
     # ---- P7 timer.
     new_commit = jnp.any(committed & ~committed_at_start, axis=1)
@@ -316,37 +391,46 @@ def pbft_bcast_round_padded(cfg: Config, st: PbftState, r, n_real, f,
     pp_val = jnp.where(accept, pm_val, pp_val)
     pp_seen = pp_seen | accept
 
-    # ---- P4 + P5: the SHARED aggregate machinery (one payload sort +
-    # top-M run tables, pbft_bcast._aggregate_tallies) with traced Q
-    # and the rung-maxed static table width — one quorum-count path for
-    # the dedicated engine and the ladder, so they cannot drift.
-    _, prepared, commit_now, _ = _aggregate_tallies(
-        pp_val, pp_seen, prepared, committed, honest, bcast, Q, m_cap,
-        side=None if no_part else side,
-        part_active=None if no_part else part_active,
-        eq_send=(byz & bcast & stance) if equiv else None)
-    dval = jnp.where(commit_now, pp_val, dval)
-    committed = committed | commit_now
-
-    # ---- P6 decide gossip: lowest-id broadcasting decider per side.
-    dec = honest[:, None] & bcast[:, None] & committed
-    if no_part:
-        src = jnp.where(dec, idx[:, None], N)
-        imin_rows = jnp.min(src, axis=0)[None, :]
-        imin = jnp.broadcast_to(imin_rows, (N, S))
+    # ---- P4 + P5 (+P6). Flat: the SHARED aggregate machinery (one
+    # payload sort + top-M run tables, pbft_bcast._aggregate_tallies)
+    # with traced Q and the rung-maxed static table width — one
+    # quorum-count path for the dedicated engine and the ladder, so
+    # they cannot drift. Switch (SPEC §9): the shared traced-
+    # segmentation combine (`_padded_switch_phases`, §6b one-broadcast
+    # uplink) — no sort at all.
+    if cfg.switch_on:
+        prepared, committed, dval = _padded_switch_phases(
+            cfg, seed, ur, n_real, honest,
+            pp_seen, pp_val, prepared, committed, dval, Q,
+            byz=byz if equiv else None, bcast_uplink=True)
     else:
-        rows = []
-        for b in (0, 1):
-            src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
-            rows.append(jnp.min(src, axis=0))
-        imin_rows = jnp.stack(rows)
-        imin = imin_rows[side]
-    adopt = (imin < N) & ~committed
-    val_rows = dval[jnp.clip(imin_rows, 0, N - 1), sarange[None, :]]
-    vfull = (jnp.broadcast_to(val_rows, (N, S)) if no_part
-             else val_rows[side])
-    dval = jnp.where(adopt, vfull, dval)
-    committed = committed | adopt
+        _, prepared, commit_now, _ = _aggregate_tallies(
+            pp_val, pp_seen, prepared, committed, honest, bcast, Q, m_cap,
+            side=None if no_part else side,
+            part_active=None if no_part else part_active,
+            eq_send=(byz & bcast & stance) if equiv else None)
+        dval = jnp.where(commit_now, pp_val, dval)
+        committed = committed | commit_now
+
+        # ---- P6 decide gossip: lowest-id broadcasting decider per side.
+        dec = honest[:, None] & bcast[:, None] & committed
+        if no_part:
+            src = jnp.where(dec, idx[:, None], N)
+            imin_rows = jnp.min(src, axis=0)[None, :]
+            imin = jnp.broadcast_to(imin_rows, (N, S))
+        else:
+            rows = []
+            for b in (0, 1):
+                src = jnp.where(dec & side_ok(b)[:, None], idx[:, None], N)
+                rows.append(jnp.min(src, axis=0))
+            imin_rows = jnp.stack(rows)
+            imin = imin_rows[side]
+        adopt = (imin < N) & ~committed
+        val_rows = dval[jnp.clip(imin_rows, 0, N - 1), sarange[None, :]]
+        vfull = (jnp.broadcast_to(val_rows, (N, S)) if no_part
+                 else val_rows[side])
+        dval = jnp.where(adopt, vfull, dval)
+        committed = committed | adopt
 
     # ---- P7 timer.
     new_commit = jnp.any(committed & ~committed_at_start, axis=1)
@@ -486,6 +570,13 @@ def _fsweep_static(cfg: Config, fs):
         raise ValueError(f"n_byzantine={cfg.n_byzantine} exceeds the "
                          f"smallest rung f={min(fs)}; every rung must "
                          f"satisfy the pbft n_byzantine <= f invariant")
+    if cfg.switch_on and cfg.n_aggregators > 3 * min(fs) + 1:
+        # Per-rung equivalence is against standalone f=fs[k] runs whose
+        # Config requires n_aggregators <= n_nodes = 3f+1.
+        raise ValueError(
+            f"n_aggregators={cfg.n_aggregators} exceeds the smallest "
+            f"rung's population 3*{min(fs)}+1 (SPEC §9: K <= n_nodes "
+            "must hold for every rung's standalone twin)")
     n_pad = 3 * max(fs) + 1
     cfg_pad = dataclasses.replace(cfg, protocol="pbft", f=max(fs),
                                   n_nodes=n_pad,

@@ -83,6 +83,7 @@ CRASH_SPLIT = {
 # keep this module's call sites terse and preserve the original seams.
 from ..ops.adversary import CRASH_TELEMETRY, crash_counts, crash_transition
 from ..ops.adversary import bitcast_i32 as _i32
+from ..ops.aggregate import AGG_TELEMETRY, agg_counts
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import freeze_down as _freeze
@@ -190,7 +191,8 @@ RAFT_TELEMETRY = ("leader_elections",    # candidates winning this round
                   "append_rejected",     # AppendEntries refused (mismatch)
                   "entries_committed",   # Σ per-node commit-index advance
                   "attack_rounds",       # SPEC §A.3 attack-active rounds
-                  ) + CRASH_TELEMETRY    # SPEC §6c (zeros when disabled)
+                  ) + CRASH_TELEMETRY \
+                  + AGG_TELEMETRY        # SPEC §9 (zeros when flat)
 
 # Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
 # recorder"): per-round duration observations bucketed on device by
@@ -362,15 +364,51 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
     reset |= granted
 
     # P2c tally: votes[c] = 1 + Σ_j [grant_j == c ∧ delivered(j, c)].
-    resp = (grant[:, None] == idx[None, :]) & deliver_e
-    if withhold:
-        resp &= honest[:, None]  # byz vote responses never travel
-    if double_grant:
-        # Byz j's response reaches EVERY candidate whose request it got.
-        byz_votes = (~honest)[:, None] & was_cand[None, :] \
-            & deliver_e.T & deliver_e
-        resp = jnp.where((~honest)[:, None], byz_votes, resp)
-    votes = 1 + jnp.sum(resp, axis=0, dtype=jnp.int32)
+    # Under net_model="switch" (SPEC §9) the vote responses route
+    # through the K aggregators: each segment-sums its members' votes
+    # per candidate (the response edge never travels point-to-point)
+    # and candidates see K pre-aggregated counts — the factorized
+    # two-hop uplink(j) ∧ downlink(a(j), c) replaces deliver_e[j, c].
+    switch = cfg.switch_on
+    if switch:
+        from ..ops.aggregate import (agg_ids, agg_round, downlink,
+                                     seg_sum, uplink_edge)
+        aggst = agg_round(cfg, seed, ur)
+        sids = agg_ids(N, cfg.n_aggregators)
+        up0 = uplink_edge(cfg, seed, aggst, 0)
+        if crash_on:
+            up0 &= up
+        contrib = (grant[:, None] == idx[None, :]) & ~eye
+        if withhold:
+            contrib &= honest[:, None]
+        if double_grant:
+            # Byz j's vote bundle claims EVERY candidate whose request
+            # it got (request leg stays flat; response rides the switch).
+            byz_votes = (~honest)[:, None] & was_cand[None, :] \
+                & deliver_e.T & ~eye
+            contrib = jnp.where((~honest)[:, None], byz_votes, contrib)
+        seg = seg_sum((contrib & up0[:, None]).astype(jnp.int32), sids,
+                      cfg.n_aggregators)                       # [K, N]
+        down0 = downlink(cfg, seed, ur, aggst, 0, idx)         # [K, N]
+        if crash_on:
+            down0 &= up[None, :]
+        votes_in = jnp.sum(jnp.where(down0, seg, 0), axis=0)
+        if elect_on:
+            votes_in = jnp.where(jam, 0, votes_in)
+        if sticky_on:
+            votes_in = jnp.where(sticky_act & (idx == tgt), 0, votes_in)
+        votes = 1 + votes_in
+    else:
+        resp = (grant[:, None] == idx[None, :]) & deliver_e
+        if withhold:
+            resp &= honest[:, None]  # byz vote responses never travel
+        if double_grant:
+            # Byz j's response reaches EVERY candidate whose request it
+            # got.
+            byz_votes = (~honest)[:, None] & was_cand[None, :] \
+                & deliver_e.T & deliver_e
+            resp = jnp.where((~honest)[:, None], byz_votes, resp)
+        votes = 1 + jnp.sum(resp, axis=0, dtype=jnp.int32)
     win = (role == ROLE_C) & (votes >= majority)
     role = jnp.where(win, ROLE_L, role)
     timer = jnp.where(win, 0, timer)
@@ -507,10 +545,11 @@ def raft_round(cfg: Config, st: RaftState, r, *, telem: bool = False,
         attacked = sticky_act.astype(jnp.int32)
     else:
         attacked = jnp.int32(0)
+    az = agg_counts(aggst) if switch else agg_counts()
     vec = jnp.stack([jnp.sum(win.astype(jnp.int32)),
                      jnp.sum(apply_.astype(jnp.int32)),
                      jnp.sum(append_rej.astype(jnp.int32)),
-                     jnp.sum(commit - st.commit), attacked, *cz])
+                     jnp.sum(commit - st.commit), attacked, *cz, *az])
     if not flight:
         return new, vec
     from ..ops.flight import bucket_counts

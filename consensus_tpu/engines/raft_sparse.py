@@ -43,6 +43,7 @@ from ..ops.adversary import delivery_edges as _edges
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import freeze_down as _freeze
+from ..ops.aggregate import agg_counts
 from ..ops.flight import bucket_counts
 from .raft import (NONE, RAFT_LATENCY, RAFT_TELEMETRY, ROLE_C, ROLE_F,
                    ROLE_L, _draw_timeout, _last_term, _match_dtype, _pick1,
@@ -297,17 +298,53 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
     timer = jnp.where(granted, 0, timer)
     reset |= granted
 
-    # P2c tally per active candidate; winners become leaders.
-    del_jc = dedge(idx[:, None], cand_ids[None, :])            # [N, A]
-    if elect_on:
-        del_jc = del_jc & ~jam
-    resp = (grant[:, None] == cand_ids[None, :]) & del_jc
-    if withhold:
-        resp &= honest[:, None]
-    if double_grant:
-        byz_votes = (~honest)[:, None] & cvalid[None, :] & del_cj.T & del_jc
-        resp = jnp.where((~honest)[:, None], byz_votes, resp)
-    votes = 1 + jnp.sum(resp, axis=0, dtype=jnp.int32)         # [A]
+    # P2c tally per active candidate; winners become leaders. Under
+    # net_model="switch" (SPEC §9) the responses route through the K
+    # aggregators — segment-summed per candidate, then combined over
+    # the delivered aggregator set (same factorized two-hop as the
+    # dense kernel; the request legs stay flat).
+    switch = cfg.switch_on
+    if switch:
+        from ..ops.aggregate import (agg_ids, agg_round, downlink,
+                                     seg_sum, uplink_edge)
+        aggst = agg_round(cfg, seed, ur)
+        sids = agg_ids(N, cfg.n_aggregators)
+        up0 = uplink_edge(cfg, seed, aggst, 0)
+        if crash_on:
+            up0 &= up
+        not_self = idx[:, None] != cand_ids[None, :]
+        contrib = (grant[:, None] == cand_ids[None, :]) \
+            & cvalid[None, :] & not_self
+        if withhold:
+            contrib &= honest[:, None]
+        if double_grant:
+            byz_votes = (~honest)[:, None] & cvalid[None, :] \
+                & del_cj.T & not_self
+            contrib = jnp.where((~honest)[:, None], byz_votes, contrib)
+        seg = seg_sum((contrib & up0[:, None]).astype(jnp.int32), sids,
+                      cfg.n_aggregators)                       # [K, A]
+        down0 = downlink(cfg, seed, ur, aggst, 0, cand_ids)    # [K, A]
+        if crash_on:
+            down0 &= up[cid][None, :]
+        votes_in = jnp.sum(jnp.where(down0, seg, 0), axis=0)
+        if elect_on:
+            votes_in = jnp.where(jam, 0, votes_in)
+        if sticky_on:
+            votes_in = jnp.where(sticky_act & (cand_ids == tgt), 0,
+                                 votes_in)
+        votes = 1 + votes_in                                   # [A]
+    else:
+        del_jc = dedge(idx[:, None], cand_ids[None, :])        # [N, A]
+        if elect_on:
+            del_jc = del_jc & ~jam
+        resp = (grant[:, None] == cand_ids[None, :]) & del_jc
+        if withhold:
+            resp &= honest[:, None]
+        if double_grant:
+            byz_votes = (~honest)[:, None] & cvalid[None, :] \
+                & del_cj.T & del_jc
+            resp = jnp.where((~honest)[:, None], byz_votes, resp)
+        votes = 1 + jnp.sum(resp, axis=0, dtype=jnp.int32)     # [A]
     win = cvalid & (role[cid] == ROLE_C) & (votes >= majority)
     win_id = jnp.where(win, cid, N)                            # N ⇒ dropped
     role = role.at[win_id].set(ROLE_L, mode="drop")
@@ -475,10 +512,11 @@ def raft_sparse_round(cfg: Config, st: RaftSparseState, r, *,
         attacked = sticky_act.astype(jnp.int32)
     else:
         attacked = jnp.int32(0)
+    az = agg_counts(aggst) if switch else agg_counts()
     vec = jnp.stack([jnp.sum(win.astype(jnp.int32)),
                      jnp.sum(apply_.astype(jnp.int32)),
                      jnp.sum(append_rej.astype(jnp.int32)),
-                     jnp.sum(commit - st.commit), attacked, *cz])
+                     jnp.sum(commit - st.commit), attacked, *cz, *az])
     if not flight:
         return new, vec
     lat = jnp.stack([bucket_counts(st.timer[cid] + 1, win),

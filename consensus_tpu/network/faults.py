@@ -166,9 +166,12 @@ def corrupt_checkpoint(path, mode: str = "flip") -> None:
     """Damage a snapshot file the way real failures would.
 
     ``truncate``    — keep only the first third of the file (torn write);
-    ``flip``        — XOR one byte mid-file (bit rot / bad sector; lands
-                      in a stored npz member, so either the zip-level or
-                      the manifest-level CRC catches it);
+    ``flip``        — XOR one byte mid-payload of the LARGEST stored npz
+                      member (bit rot / bad sector; targeting the member
+                      data deterministically — a fixed mid-FILE offset
+                      used to land in zip padding whenever the embedded
+                      config JSON grew — so either the zip-level or the
+                      manifest-level CRC catches it);
     ``leaf-tamper`` — rewrite the archive with one leaf's bytes modified
                       but the ORIGINAL ``__meta__`` kept: the zip
                       container is internally consistent, so only the
@@ -181,7 +184,14 @@ def corrupt_checkpoint(path, mode: str = "flip") -> None:
     if mode == "truncate":
         path.write_bytes(bytes(data[: max(1, len(data) // 3)]))
     elif mode == "flip":
-        data[len(data) // 2] ^= 0xFF
+        import struct
+        import zipfile
+        with zipfile.ZipFile(path) as z:
+            info = max(z.infolist(), key=lambda i: i.file_size)
+        off = info.header_offset
+        # Local file header: name/extra lengths at +26, data at +30+n+m.
+        n, m = struct.unpack("<HH", data[off + 26:off + 30])
+        data[off + 30 + n + m + info.file_size // 2] ^= 0xFF
         path.write_bytes(bytes(data))
     elif mode == "leaf-tamper":
         with np.load(path) as z:

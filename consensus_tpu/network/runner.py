@@ -1099,6 +1099,7 @@ def run_knob_batch(cfg: Config, eng: EngineDef, seeds, kmat, *,
             "the base config to the generation's lane count")
     gates = {"crash_cutoff": cfg.crash_on, "recover_cutoff": cfg.crash_on,
              "miss_cutoff": cfg.miss_on,
+             "suppress_cutoff": cfg.suppress_on,
              "partition_cutoff": not cfg.no_partition,
              "attack_cutoff": cfg.attack != "none",
              "attack_target": cfg.attack != "none"}

@@ -52,8 +52,9 @@ COMMIT_COUNTERS = {
 # attack counters (per-producer slot misses, targeted-attack rounds),
 # plus the protocol's own disruption signals (elections / view changes
 # are what an availability attack looks like from inside the protocol).
-FAULT_COUNTERS = ("crashes", "nodes_down", "missed_slots", "attack_rounds",
-                  "leader_elections", "view_changes")
+FAULT_COUNTERS = ("crashes", "nodes_down", "missed_slots",
+                  "suppressed_slots", "attack_rounds", "agg_down_rounds",
+                  "stale_serves", "leader_elections", "view_changes")
 
 
 @dataclasses.dataclass(frozen=True)

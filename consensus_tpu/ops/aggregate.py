@@ -1,0 +1,376 @@
+"""SPEC §9 in-network vote aggregation — the shared switch delivery layer.
+
+PAPERS.md 1605.05619 moves consensus vote aggregation into programmable
+network hardware; ``Config.net_model="switch"`` is that model as a
+delivery layer between send and receive, shared by every vote/quorum
+path (1905.10786's lesson: optimizations expressed at the right layer
+port across protocols). K aggregator vertices partition the population
+into contiguous segments (``agg_of(i) = i // ceil(N/K)``); a sender's
+SPEC §2 edge draw lands on its aggregator (uplink), aggregators combine
+per-segment — masked sums for counts, max/min for order-statistic
+quantities — and receivers see K pre-aggregated values instead of N
+messages (downlink).
+
+Draw keying (all counter-based; scalar twin ``cpp/oracle.cpp AggNet``):
+
+  * Aggregator ``a`` of phase ``ph`` is the synthetic vertex
+    ``g = N + ph*K + a`` — outside the node id range, so switch-path
+    draws can never collide with the flat §2 edge draws that still
+    carry requests/proposals. The PARTITION side of an aggregator is
+    keyed on the phase-independent vertex ``N + a`` (one physical
+    switch, one side).
+  * Uplink (edge engines): the §2 mixer draw ``(q, i, g)`` + §A.2
+    delayed retransmission + the §2 bipartition at round ``q``
+    (``side_q(i) == side_q(N + a)``). The §6b bcast engine's uplink is
+    its per-sender broadcast key ``(q, i, i)`` instead — one atomic
+    broadcast into the switch per round.
+  * Downlink: ``(r, g, j)`` + delay + partition at the CURRENT round r.
+  * Fault axes (STREAM_AGG, per (round, aggregator)): failure — a down
+    aggregator silently drops its whole segment, both directions — and
+    STALE state: the aggregator serves the segment it combined from
+    round ``q = r - d``'s delivery pattern, ``d in [1, agg_max_stale]``
+    drawn per (round, aggregator). Staleness is a pure re-draw against
+    shifted round keys (contributions/values stay current-round — a
+    "previous combined value" would be a queue riding the carry, which
+    SPEC §A.2 already forbids); only the uplink shifts, the downlink
+    stays at ``r``.
+
+Self votes never travel: each receiver counts itself locally, and its
+own switch-delivered copy (if the two-hop delivered it back) is
+subtracted — the factorized two-hop keeps that exact per receiver.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from .adversary import cutoff as _lt
+from .adversary import delayed_open, draw
+
+I32_MAX = jnp.iinfo(jnp.int32).max
+I32_MIN = jnp.iinfo(jnp.int32).min
+
+# SPEC §9 telemetry tail shared by every switch-capable engine's counter
+# vector (zeros when net_model="flat", like the §6c CRASH_TELEMETRY).
+AGG_TELEMETRY = ("agg_down_rounds",  # Σ per-round failed aggregators
+                 "stale_serves")     # Σ per-round stale-serving (alive) aggs
+
+# Phase table (documented in SPEC §9; phases are per-protocol, so ids
+# may repeat across protocols — one run never mixes them):
+#   raft / raft_sparse : 0 = election vote responses (P2c)
+#   pbft (both models) : 0 = prepare votes (P4), 1 = commit votes (P5),
+#                        2 = decide gossip (P6)
+#   paxos              : 0 = promises, 1 = accept responses
+#   hotstuff           : 0 = votes
+
+
+def n_segments(N: int, K: int) -> int:
+    """Segment width B = ceil(N/K); ids i // B land in [0, ceil(N/B))."""
+    return -(-N // K)
+
+
+def agg_ids(N: int, K: int):
+    """Static node → aggregator partition: [N] i32, i // ceil(N/K)."""
+    B = n_segments(N, K)
+    return jnp.arange(N, dtype=jnp.int32) // jnp.int32(B)
+
+
+class AggRound(NamedTuple):
+    """Per-round aggregator fault state (pure draws; nothing rides the
+    carry). ``alive`` is None when agg_fail_rate == 0 (static no-draw);
+    ``q`` is the per-aggregator effective UPLINK round — the scalar
+    round ``r`` itself when agg_stale_rate == 0."""
+    alive: jnp.ndarray | None   # [K] bool or None
+    q: jnp.ndarray              # [] or [K] uint32
+    down_count: jnp.ndarray     # [] i32 (telemetry)
+    stale_count: jnp.ndarray    # [] i32 (telemetry)
+
+
+def agg_round(cfg, seed, r) -> AggRound:
+    """Draw the round's STREAM_AGG fault state for all K aggregators."""
+    K = cfg.n_aggregators
+    ur = jnp.asarray(r, jnp.uint32)
+    ua = jnp.arange(K, dtype=jnp.uint32)
+    z = jnp.int32(0)
+    if cfg.agg_fail_on:
+        alive = ~(draw(seed, rng.STREAM_AGG, ur, 0, ua)
+                  < _lt(cfg.agg_fail_cutoff))
+        down_count = jnp.sum((~alive).astype(jnp.int32))
+    else:
+        alive, down_count = None, z
+    if cfg.agg_stale_on:
+        stale = draw(seed, rng.STREAM_AGG, ur, 1, ua) \
+            < _lt(cfg.agg_stale_cutoff)
+        d = jnp.uint32(1) + (draw(seed, rng.STREAM_AGG, ur, 2, ua)
+                             % jnp.uint32(cfg.agg_max_stale))
+        serving = stale & (ur >= d)   # round keys must not wrap (§A.2)
+        q = jnp.where(serving, ur - d, ur)
+        live_serving = serving if alive is None else (serving & alive)
+        stale_count = jnp.sum(live_serving.astype(jnp.int32))
+    else:
+        q, stale_count = ur, z
+    return AggRound(alive, q, down_count, stale_count)
+
+
+def agg_counts(agg: AggRound | None = None):
+    """The :data:`AGG_TELEMETRY` tail of an engine's counter vector —
+    call with no args for the flat-model zeros."""
+    if agg is None:
+        return (jnp.int32(0),) * 2
+    return (agg.down_count, agg.stale_count)
+
+
+def take_seg(table, seg_ids, K: int):
+    """``table[seg_ids]`` for a [K, ...] table with STATIC tiny K: a
+    K-deep fused select chain (no gather unit; works with traced
+    ``seg_ids`` — the padded f-ladder's traced segmentation)."""
+    tail = (1,) * (table.ndim - 1)
+    sel = seg_ids.reshape(seg_ids.shape + tail)
+    out = jnp.broadcast_to(table[0][None], seg_ids.shape + table.shape[1:])
+    for k in range(1, K):
+        out = jnp.where(sel == k, table[k][None], out)
+    return out
+
+
+def _seg_reduce(x, seg_ids, K: int, kind: str, identity, traced: bool):
+    """Per-segment reduce of [N, ...] → [K, ...]. Static segmentation
+    reshapes into [K, B, ...] (pure reduction, no scatter); the traced
+    path (padded f-ladder: B depends on the traced n_real) goes through
+    jax.ops.segment_*."""
+    if traced:
+        fn = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+              "min": jax.ops.segment_min}[kind]
+        out = fn(x, seg_ids, num_segments=K)
+        if kind != "sum":
+            # segment_max/min fill EMPTY segments with dtype extrema of
+            # the wrong sign; normalize to the caller's identity.
+            counts = jax.ops.segment_sum(
+                jnp.ones(x.shape[0], jnp.int32), seg_ids, num_segments=K)
+            tail = (1,) * (x.ndim - 1)
+            out = jnp.where((counts > 0).reshape((K,) + tail), out,
+                            identity)
+        return out
+    N = x.shape[0]
+    B = n_segments(N, K)
+    pad = K * B - N
+    if pad:
+        fill = jnp.full((pad,) + x.shape[1:], identity, x.dtype)
+        x = jnp.concatenate([x, fill], axis=0)
+    x = x.reshape((K, B) + x.shape[1:])
+    op = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[kind]
+    return op(x, axis=1)
+
+
+def seg_sum(x, seg_ids, K: int, traced: bool = False):
+    return _seg_reduce(x, seg_ids, K, "sum", jnp.asarray(0, x.dtype),
+                       traced)
+
+
+def seg_max(x, seg_ids, K: int, identity, traced: bool = False):
+    return _seg_reduce(x, seg_ids, K, "max", identity, traced)
+
+
+def seg_min(x, seg_ids, K: int, identity, traced: bool = False):
+    return _seg_reduce(x, seg_ids, K, "min", identity, traced)
+
+
+# --- the two-hop delivery masks --------------------------------------------
+
+def _open_edge(cfg, seed, q, src, dst):
+    """§2 drop leg + §A.2 delayed retransmission on (q, src, dst)."""
+    open_ = ~(rng.delivery_u32_jnp(seed, q, src, dst)
+              < _lt(cfg.drop_cutoff))
+    if cfg.max_delay_rounds > 0:
+        open_ |= delayed_open(seed, q, src, dst, cfg.drop_cutoff,
+                              cfg.max_delay_rounds)
+    return open_
+
+
+def _part_pair_ok(cfg, seed, q, id_a, id_b):
+    """§2 bipartition check at round key(s) ``q`` for vertex ids
+    ``id_a``/``id_b`` (nodes or N+a switch vertices; broadcasts)."""
+    part_active = draw(seed, rng.STREAM_PARTITION, q, 0, 0) \
+        < _lt(cfg.partition_cutoff)
+    side_a = draw(seed, rng.STREAM_PARTITION, q, 1, id_a) & jnp.uint32(1)
+    side_b = draw(seed, rng.STREAM_PARTITION, q, 1, id_b) & jnp.uint32(1)
+    return (side_a == side_b) | ~part_active
+
+
+def _uplink(cfg, seed, agg: AggRound, seg_ids, K: int, n_vert,
+            dst_kind: str, phase: int, traced: bool):
+    """Shared uplink body: [N] bool. ``dst_kind`` picks the edge-model
+    synthetic vertex ("edge") or the §6b broadcast key ("bcast");
+    ``n_vert`` is the vertex base N (traced n_real in the ladder)."""
+    N = seg_ids.shape[0]
+    ui = jnp.arange(N, dtype=jnp.uint32)
+    base = jnp.asarray(n_vert, jnp.uint32)
+    ua = seg_ids.astype(jnp.uint32)
+    q = agg.q if agg.q.ndim == 0 else take_seg(agg.q, seg_ids, K)
+    if dst_kind == "edge":
+        dst = base + jnp.uint32(phase * K) + ua
+    else:
+        dst = ui
+    open_ = _open_edge(cfg, seed, q, ui, dst)
+    if not cfg.no_partition:
+        open_ &= _part_pair_ok(cfg, seed, q, ui, base + ua)
+    return open_
+
+
+def uplink_edge(cfg, seed, agg: AggRound, phase: int, *, seg_ids=None,
+                n_vert=None, traced: bool = False):
+    """Edge-model uplink mask [N]: sender i's §2 draw to its aggregator
+    vertex, at the aggregator's effective (possibly stale) round."""
+    K = cfg.n_aggregators
+    if seg_ids is None:
+        seg_ids = agg_ids(cfg.n_nodes, K)
+    if n_vert is None:
+        n_vert = cfg.n_nodes
+    return _uplink(cfg, seed, agg, seg_ids, K, n_vert, "edge", phase,
+                   traced)
+
+
+def uplink_bcast(cfg, seed, agg: AggRound, *, seg_ids=None, n_vert=None,
+                 traced: bool = False):
+    """§6b uplink mask [N]: the sender's one atomic broadcast draw
+    (key (q, i, i)) lands on its aggregator — shared by every phase of
+    the round, exactly the §6b fault granularity."""
+    K = cfg.n_aggregators
+    if seg_ids is None:
+        seg_ids = agg_ids(cfg.n_nodes, K)
+    if n_vert is None:
+        n_vert = cfg.n_nodes
+    return _uplink(cfg, seed, agg, seg_ids, K, n_vert, "bcast", 0, traced)
+
+
+def downlink(cfg, seed, r, agg: AggRound, phase: int, dst, *, n_vert=None):
+    """Downlink mask [K, len(dst)]: aggregator a → receiver id dst[j] at
+    the CURRENT round r. Dead aggregators (fail draw) deliver nothing;
+    negative dst ids (masked lanes) receive nothing."""
+    K = cfg.n_aggregators
+    if n_vert is None:
+        n_vert = cfg.n_nodes
+    base = jnp.asarray(n_vert, jnp.uint32)
+    ua = jnp.arange(K, dtype=jnp.uint32)[:, None]
+    valid = jnp.asarray(dst, jnp.int32) >= 0
+    udst = jnp.clip(jnp.asarray(dst, jnp.int32), 0, None) \
+        .astype(jnp.uint32)[None, :]
+    ur = jnp.asarray(r, jnp.uint32)
+    g = base + jnp.uint32(phase * K) + ua
+    open_ = _open_edge(cfg, seed, ur, g, udst)
+    if not cfg.no_partition:
+        open_ &= _part_pair_ok(cfg, seed, ur, base + ua, udst)
+    if agg.alive is not None:
+        open_ &= agg.alive[:, None]
+    return open_ & valid[None, :]
+
+
+def downlink_self(cfg, seed, r, agg: AggRound, phase: int, *, seg_ids=None,
+                  n_vert=None):
+    """[N] mask: does node j's OWN aggregator deliver back to j this
+    round/phase? The self-duplicate subtraction term — a receiver
+    counts its own vote locally, so the switch-returned copy must be
+    discounted. Elementwise draws (a(j) is a pure function of j)."""
+    K = cfg.n_aggregators
+    if seg_ids is None:
+        seg_ids = agg_ids(cfg.n_nodes, K)
+    if n_vert is None:
+        n_vert = cfg.n_nodes
+    N = seg_ids.shape[0]
+    base = jnp.asarray(n_vert, jnp.uint32)
+    ua = seg_ids.astype(jnp.uint32)
+    uj = jnp.arange(N, dtype=jnp.uint32)
+    ur = jnp.asarray(r, jnp.uint32)
+    g = base + jnp.uint32(phase * K) + ua
+    open_ = _open_edge(cfg, seed, ur, g, uj)
+    if not cfg.no_partition:
+        open_ &= _part_pair_ok(cfg, seed, ur, base + ua, uj)
+    if agg.alive is not None:
+        open_ &= take_seg(agg.alive, seg_ids, K)
+    return open_
+
+
+# --- pbft value-matched tallies --------------------------------------------
+
+def value_votes(vals, contrib, up, down, down_own, seg_ids, K: int, *,
+                eq_up=None, traced: bool = False):
+    """SPEC §9 switch tally for value-matched votes (pbft P4/P5): each
+    aggregator combines its segment's live contributions into
+    ``(count, vmax, vmin)`` — it SERVES ``(count, value)`` iff the
+    segment is value-UNIFORM (vmax == vmin; a mixed segment is the
+    switch-vs-replica inconsistency a receiver can detect but not
+    resolve, so it serves nothing). Receivers total the counts of
+    delivered serving segments whose value matches their own.
+
+    ``vals``/``contrib``: [N, S]; ``up``: [N] uplink mask (sender
+    crash/withhold already folded by the caller); ``down``: [K, N]
+    downlink; ``down_own``: [N] own-aggregator return mask; ``eq_up``:
+    optional [N] value-blind equivocating-support senders (byz & stance
+    & uplink) — their count rides any SERVING segment (the switch has
+    no value to pin a byz claim to, so an all-byz segment serves
+    nothing). Returns [N, S] i32 switch-delivered counts with the
+    receiver's own returned copy subtracted — the caller adds the local
+    self vote."""
+    live = contrib & up[:, None]                                   # [N, S]
+    cnt = seg_sum(live.astype(jnp.int32), seg_ids, K, traced)      # [K, S]
+    vmax = seg_max(jnp.where(live, vals, I32_MIN), seg_ids, K,
+                   I32_MIN, traced)
+    vmin = seg_min(jnp.where(live, vals, I32_MAX), seg_ids, K,
+                   I32_MAX, traced)
+    serve = (cnt > 0) & (vmax == vmin)                             # [K, S]
+    total = cnt
+    if eq_up is not None:
+        eqc = seg_sum(eq_up.astype(jnp.int32), seg_ids, K, traced)  # [K]
+        total = cnt + eqc[:, None]
+    # Receiver combine as a static K-deep accumulation of [N, S]
+    # fusions — a [K, N, S] broadcast would materialize K copies of
+    # the population grid per phase (measured: +2.4 GB/round on the
+    # pbft-100k-bcast-switch card); per-aggregator terms read only
+    # [N]- and [S]-shaped operands against ``vals`` and fuse into one
+    # elementwise chain.
+    c = jnp.zeros(vals.shape, jnp.int32)
+    for a in range(K):
+        hit = (down[a][:, None] & serve[a][None, :]
+               & (vmax[a][None, :] == vals))
+        c = c + jnp.where(hit, total[a][None, :], 0)
+    serve_own = take_seg(serve, seg_ids, K)                        # [N, S]
+    val_own = take_seg(vmax, seg_ids, K)
+    hit_own = serve_own & (val_own == vals) & down_own[:, None]
+    c = c - (live & hit_own).astype(jnp.int32)
+    if eq_up is not None:
+        c = c - ((eq_up & down_own)[:, None] & serve_own
+                 & (val_own == vals)).astype(jnp.int32)
+    return c
+
+
+def min_id_votes(dec, dval, up, down, seg_ids, K: int, N_pad: int, *,
+                 traced: bool = False):
+    """SPEC §9 switch form of the lowest-id decide gossip (pbft P6):
+    each aggregator serves the MIN id of its live deciding senders plus
+    that sender's value (max/min order-statistic combine); a receiver
+    adopts from the lowest id across its delivered segments. Returns
+    ``(imin, vadopt)``: [N, S] (imin == N_pad ⇒ no decider reached)."""
+    idx = jnp.arange(dec.shape[0], dtype=jnp.int32)
+    live = dec & up[:, None]
+    src = jnp.where(live, idx[:, None], N_pad)
+    mid = seg_min(src, seg_ids, K, jnp.int32(N_pad), traced)       # [K, S]
+    mid_own = take_seg(mid, seg_ids, K)                            # [N, S]
+    win = live & (idx[:, None] == mid_own)
+    sval = seg_max(jnp.where(win, dval, I32_MIN), seg_ids, K,
+                   I32_MIN, traced)                                # [K, S]
+    # Static K-deep accumulation (see value_votes: a [K, N, S]
+    # broadcast would materialize the grid K times).
+    imin = jnp.full(dec.shape, N_pad, jnp.int32)
+    for a in range(K):
+        cand = jnp.where(down[a][:, None] & (mid[a][None, :] < N_pad),
+                         mid[a][None, :], N_pad)
+        imin = jnp.minimum(imin, cand)
+    vadopt = jnp.full(dec.shape, I32_MIN, jnp.int32)
+    for a in range(K):
+        hit = (down[a][:, None] & (mid[a][None, :] == imin)
+               & (imin < N_pad))
+        vadopt = jnp.maximum(
+            vadopt, jnp.where(hit, sval[a][None, :], I32_MIN))
+    return imin, vadopt

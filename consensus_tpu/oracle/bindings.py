@@ -58,17 +58,17 @@ def get_lib() -> ctypes.CDLL:
     lib.ctpu_delivery_u32.restype = u32
     lib.ctpu_delivery_u32.argtypes = [u64, u32, u32, u32]
     lib.ctpu_raft_run.restype = ctypes.c_int
-    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 17 + [p32] * 5
+    lib.ctpu_raft_run.argtypes = [u64] + [u32] * 22 + [p32] * 5
     p8 = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
     lib.ctpu_paxos_run.restype = ctypes.c_int
-    lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 12 + [p32, p8, p32, p32, p32]
+    lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 17 + [p32, p8, p32, p32, p32]
     lib.ctpu_pbft_run.restype = ctypes.c_int
-    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 16 + [p8, p32, p32]
+    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 21 + [p8, p32, p32]
     pi32 = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
     lib.ctpu_dpos_run.restype = ctypes.c_int
-    lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 14 + [p32] * 3 + [pi32]
+    lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 16 + [p32] * 3 + [pi32]
     lib.ctpu_hotstuff_run.restype = ctypes.c_int
-    lib.ctpu_hotstuff_run.argtypes = [u64] + [u32] * 13 + [p8, p32, p32, p32]
+    lib.ctpu_hotstuff_run.argtypes = [u64] + [u32] * 18 + [p8, p32, p32, p32]
     _lib = lib
     return lib
 
@@ -102,6 +102,8 @@ def raft_run(cfg, sweep: int = 0, delivery: str = "auto"):
         _delivery_code(delivery),
         cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
         cfg.max_delay_rounds,
+        1 if cfg.net_model == "switch" else 0, cfg.n_aggregators,
+        cfg.agg_fail_cutoff, cfg.agg_stale_cutoff, cfg.agg_max_stale,
         out["commit"], out["log_term"].reshape(-1), out["log_val"].reshape(-1),
         out["term"], out["role"])
     if rc != 0:
@@ -127,6 +129,8 @@ def paxos_run(cfg, sweep: int = 0, delivery: str = "auto"):
         _delivery_code(delivery),
         cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
         cfg.max_delay_rounds,
+        1 if cfg.net_model == "switch" else 0, cfg.n_aggregators,
+        cfg.agg_fail_cutoff, cfg.agg_stale_cutoff, cfg.agg_max_stale,
         out["learned_val"].reshape(-1), out["learned_mask"].reshape(-1),
         out["promised"].reshape(-1), out["acc_bal"].reshape(-1),
         out["acc_val"].reshape(-1))
@@ -153,6 +157,8 @@ def pbft_run(cfg, sweep: int = 0, delivery: str = "auto"):
         _delivery_code(delivery),
         cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
         cfg.max_delay_rounds,
+        1 if cfg.net_model == "switch" else 0, cfg.n_aggregators,
+        cfg.agg_fail_cutoff, cfg.agg_stale_cutoff, cfg.agg_max_stale,
         out["committed"].reshape(-1), out["dval"].reshape(-1), out["view"])
     if rc != 0:
         raise RuntimeError(f"oracle pbft_run failed rc={rc}")
@@ -177,6 +183,8 @@ def hotstuff_run(cfg, sweep: int = 0):
         cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
         cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
         cfg.max_delay_rounds,
+        1 if cfg.net_model == "switch" else 0, cfg.n_aggregators,
+        cfg.agg_fail_cutoff, cfg.agg_stale_cutoff, cfg.agg_max_stale,
         out["committed"].reshape(-1), out["dval"].reshape(-1),
         out["clen"], out["view"])
     if rc != 0:
@@ -200,6 +208,7 @@ def dpos_run(cfg, sweep: int = 0):
         cfg.epoch_len, cfg.drop_cutoff, cfg.partition_cutoff, cfg.churn_cutoff,
         cfg.crash_cutoff, cfg.recover_cutoff, cfg.max_crashed,
         cfg.miss_cutoff, cfg.max_delay_rounds,
+        cfg.suppress_cutoff, cfg.suppress_window,
         out["chain_r"].reshape(-1), out["chain_p"].reshape(-1),
         out["chain_len"], out["lib"])
     if rc != 0:

@@ -149,6 +149,26 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
         window=4,
         tuned=dict(n_nodes=7, f=2, n_rounds=96, log_capacity=96)),
     Scenario(
+        name="stale-aggregator-inconsistency",
+        description="SPEC §9 switch delivery under aggregator faults "
+                    "(hotstuff): votes route through 2 in-network "
+                    "aggregators — a failed aggregator silently drops "
+                    "half the vote segment and a stale one re-serves a "
+                    "shifted round's delivery pattern (the paper's "
+                    "stale-in-switch-state axis, PAPERS.md 1605.05619), "
+                    "so QCs fail, the pacemaker burns view timeouts, "
+                    "and the chained 3-commit stalls — switch-vs-replica "
+                    "divergence bounded by the flight recorder.",
+        protocol="hotstuff",
+        overrides=dict(net_model="switch", n_aggregators=2,
+                       agg_fail_rate=0.3, agg_stale_rate=0.5,
+                       agg_max_stale=4, drop_rate=0.2, view_timeout=4),
+        bounds=TimelineBounds(max_availability=0.6, min_availability=0.1,
+                              min_stall_windows=4,
+                              max_recovery_rounds=48),
+        window=4,
+        tuned=dict(n_nodes=7, f=2, n_rounds=96, log_capacity=96)),
+    Scenario(
         name="crash-churn-under-partition",
         description="SPEC §6c crash/recover under intermittent "
                     "bipartitions and leader churn (PBFT): view changes "

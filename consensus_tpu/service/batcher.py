@@ -46,7 +46,8 @@ SWEEP_AXIS_FIELDS = frozenset({"seed", "n_sweeps"})
 # see knob_key).
 KNOB_VALUE_FIELDS = frozenset({
     "drop_rate", "partition_rate", "churn_rate", "crash_prob",
-    "recover_prob", "miss_rate", "attack_rate", "attack_target",
+    "recover_prob", "miss_rate", "suppress_rate", "attack_rate",
+    "attack_target",
 })
 
 
@@ -81,8 +82,8 @@ def knob_key(job) -> tuple | None:
     cfg = job.cfg()
     if sweep_key(job) is None or cfg.telemetry_window <= 0:
         return None
-    gates = ("gates", cfg.crash_on, cfg.miss_on, cfg.no_partition,
-             cfg.attack)
+    gates = ("gates", cfg.crash_on, cfg.miss_on, cfg.suppress_on,
+             cfg.no_partition, cfg.attack)
     return ("knob", gates) + _identity(
         cfg, minus=SWEEP_AXIS_FIELDS | KNOB_VALUE_FIELDS)
 

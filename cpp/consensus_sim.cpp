@@ -46,6 +46,14 @@ struct Args {
   // SPEC §A.1 per-producer DPoS slot faults / §A.2 bounded delay.
   double miss_rate = 0.0;
   uint32_t max_delay_rounds = 0;
+  // SPEC §A.4 correlated DPoS producer suppression (window-keyed).
+  double suppress_rate = 0.0;
+  uint32_t suppress_window = 16;
+  // SPEC §9 in-network vote aggregation (mirrored in oracle.cpp AggNet).
+  std::string net_model = "flat";  // "flat" | "switch"
+  uint32_t n_aggregators = 0;
+  double agg_fail_rate = 0.0, agg_stale_rate = 0.0;
+  uint32_t agg_max_stale = 1;
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
   std::string byz_mode = "silent";
   std::string fault_model = "edge";  // "edge" (SPEC §2) | "bcast" (§6b, pbft)
@@ -92,7 +100,10 @@ uint32_t prob_threshold_u32(double p) {
       "  [--drop-rate P] [--partition-rate P] [--churn-rate P]\n"
       "  [--crash-prob P] [--recover-prob P] [--max-crashed K]  (SPEC 6c)\n"
       "  [--miss-rate P]           (SPEC A.1 per-producer slot miss; dpos)\n"
+      "  [--suppress-rate P] [--suppress-window W]  (SPEC A.4; dpos)\n"
       "  [--max-delay-rounds D]    (SPEC A.2 bounded delay, D <= 16)\n"
+      "  [--net-model flat|switch] [--n-aggregators K]   (SPEC 9)\n"
+      "  [--agg-fail-rate P] [--agg-stale-rate P] [--agg-max-stale D]\n"
       "  [--f F] [--view-timeout T] [--n-byzantine K]\n"
       "  [--byz-mode silent|equivocate] [--fault-model edge|bcast]\n"
       "  [--oracle-delivery auto|dense|edge]  (cpu engine; digests equal)\n"
@@ -133,7 +144,14 @@ Args parse(int argc, char** argv) {
     else if (k == "--recover-prob") a.recover_prob = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--max-crashed") a.max_crashed = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--miss-rate") a.miss_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--suppress-rate") a.suppress_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--suppress-window") a.suppress_window = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--max-delay-rounds") a.max_delay_rounds = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--net-model") a.net_model = need(k.c_str());
+    else if (k == "--n-aggregators") a.n_aggregators = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--agg-fail-rate") a.agg_fail_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--agg-stale-rate") a.agg_stale_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--agg-max-stale") a.agg_max_stale = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--f") a.f = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--view-timeout") a.view_timeout = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--n-byzantine") a.n_byzantine = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
@@ -194,6 +212,54 @@ Args parse(int argc, char** argv) {
                  "registry (/metrics, /status); the scalar oracle records "
                  "none — run with --engine tpu (this front door re-execs "
                  "the Python CLI for it)\n");
+    std::exit(2);
+  }
+  if (a.net_model != "flat" && a.net_model != "switch") {
+    std::fprintf(stderr, "unknown --net-model %s\n", a.net_model.c_str());
+    std::exit(2);
+  }
+  if (a.net_model == "switch") {
+    if (a.protocol == "dpos") {
+      std::fprintf(stderr,
+                   "--net-model switch aggregates vote/quorum responses "
+                   "(SPEC 9); dpos's producer row doesn't vote — the model "
+                   "would be a silent no-op\n");
+      std::exit(2);
+    }
+    if (a.n_aggregators < 1 || a.n_aggregators > a.nodes) {
+      std::fprintf(stderr,
+                   "--net-model switch needs 1 <= --n-aggregators <= "
+                   "--nodes (SPEC 9)\n");
+      std::exit(2);
+    }
+  } else if (a.n_aggregators != 0 || a.agg_fail_rate != 0.0 ||
+             a.agg_stale_rate != 0.0 || a.agg_max_stale != 1) {
+    std::fprintf(stderr,
+                 "--n-aggregators/--agg-fail-rate/--agg-stale-rate/"
+                 "--agg-max-stale require --net-model switch (SPEC 9) — "
+                 "they would be silently ignored\n");
+    std::exit(2);
+  }
+  if (a.agg_max_stale < 1 || a.agg_max_stale > 8) {
+    std::fprintf(stderr, "--agg-max-stale must be in [1, 8] (SPEC 9)\n");
+    std::exit(2);
+  }
+  if (a.suppress_rate > 0 && a.protocol != "dpos") {
+    std::fprintf(stderr,
+                 "--suppress-rate (SPEC A.4) is a correlated DPoS "
+                 "producer-suppression adversary; %s has no producer "
+                 "schedule and would silently ignore it\n",
+                 a.protocol.c_str());
+    std::exit(2);
+  }
+  if (a.suppress_window < 1) {
+    std::fprintf(stderr, "--suppress-window must be >= 1\n");
+    std::exit(2);
+  }
+  if (a.suppress_window != 16 && a.suppress_rate == 0.0) {
+    std::fprintf(stderr,
+                 "--suppress-window requires --suppress-rate > 0 "
+                 "(SPEC A.4) — it would be silently ignored\n");
     std::exit(2);
   }
   if (a.miss_rate > 0 && a.protocol != "dpos") {
@@ -275,6 +341,13 @@ int run_cpu(const Args& a) {
   cfg.max_crashed = a.max_crashed;
   cfg.miss_cut = prob_threshold_u32(a.miss_rate);
   cfg.max_delay = a.max_delay_rounds;
+  cfg.suppress_cut = prob_threshold_u32(a.suppress_rate);
+  cfg.suppress_window = a.suppress_window;
+  cfg.net_switch = a.net_model == "switch" ? 1 : 0;
+  cfg.n_aggregators = a.n_aggregators;
+  cfg.agg_fail_cut = prob_threshold_u32(a.agg_fail_rate);
+  cfg.agg_stale_cut = prob_threshold_u32(a.agg_stale_rate);
+  cfg.agg_max_stale = a.agg_max_stale;
   cfg.f = a.f;
   cfg.view_timeout = a.view_timeout;
   cfg.n_byzantine = a.n_byzantine;

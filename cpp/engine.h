@@ -43,6 +43,19 @@ struct SimConfig {
   // SPEC §A.2 bounded message delay: a dropped flight may arrive via a
   // retransmission d <= max_delay rounds later (threefry.h delayed_open).
   uint32_t max_delay = 0;
+  // SPEC §9 in-network vote aggregation (net_model="switch"): the
+  // vote/quorum responses of raft/pbft/paxos/hotstuff route through
+  // n_aggregators aggregator vertices (contiguous node segments);
+  // STREAM_AGG drives the per-(round, aggregator) failure (a down
+  // aggregator silently drops its whole segment) and stale-serve
+  // (uplink re-drawn against a shifted round key, depth <= max_stale)
+  // fault axes. Not a dpos model (the producer row doesn't vote).
+  uint32_t net_switch = 0, n_aggregators = 0;
+  uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
+  // SPEC §A.4 correlated DPoS producer suppression: one draw per
+  // (round / suppress_window, producer) — a suppressed producer misses
+  // every slot inside the window (dpos only).
+  uint32_t suppress_cut = 0, suppress_window = 16;
   // Oracle delivery-layer strategy (execution only — decided logs are
   // byte-identical either way, SPEC §2 draws are pure counter functions):
   // 0 = auto (per-engine choice), 1 = dense [N,N] materialization,

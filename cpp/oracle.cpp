@@ -128,6 +128,90 @@ inline bool churn_fires(uint64_t seed, uint32_t r, uint32_t cut) {
   return random_u32(seed, STREAM_CHURN, r, 0, 0) < cut;
 }
 
+// SPEC §9 in-network vote aggregation — the scalar twin of
+// ops/aggregate.py (net_model="switch"). K aggregator vertices
+// partition the population into contiguous segments (a(i) = i / B,
+// B = ceil(N/K)); vote responses travel sender → aggregator (uplink,
+// the sender's §2 edge draw at the aggregator's effective round) →
+// receiver (downlink at the current round). STREAM_AGG drives the
+// per-(round, aggregator) fault axes: failure (a down aggregator
+// silently drops its whole segment) and stale state (the uplink
+// re-draws against a shifted round key r - d, d <= max_stale — a pure
+// re-draw, §A.2-style; values/contributions stay current-round).
+// Aggregator a of phase ph is the synthetic vertex N + ph*K + a; its
+// partition SIDE is keyed on the phase-independent vertex N + a.
+struct AggNet {
+  bool on = false;
+  uint32_t N = 0, K = 1, B = 1;
+  uint32_t drop_cut = 0, part_cut = 0, max_delay = 0;
+  uint64_t seed = 0;
+  uint32_t r = 0;
+  std::vector<uint8_t> alive;  // [K]
+  std::vector<uint32_t> q;     // [K] effective uplink round
+
+  uint32_t agg_of(uint32_t i) const { return i / B; }
+
+  void begin_round(uint64_t seed_, uint32_t n, uint32_t k, uint32_t r_,
+                   uint32_t drop_cut_, uint32_t part_cut_,
+                   uint32_t max_delay_, uint32_t fail_cut,
+                   uint32_t stale_cut, uint32_t max_stale) {
+    on = true;
+    seed = seed_;
+    N = n;
+    K = k;
+    B = (n + k - 1) / k;
+    drop_cut = drop_cut_;
+    part_cut = part_cut_;
+    max_delay = max_delay_;
+    r = r_;
+    alive.assign(K, 1);
+    q.assign(K, r);
+    for (uint32_t a = 0; a < K; ++a) {
+      alive[a] = !(random_u32(seed, STREAM_AGG, r, 0, a) < fail_cut);
+      const bool stale = random_u32(seed, STREAM_AGG, r, 1, a) < stale_cut;
+      const uint32_t d =
+          1 + random_u32(seed, STREAM_AGG, r, 2, a) % max_stale;
+      if (stale && r >= d) q[a] = r - d;  // round keys must not wrap
+    }
+  }
+
+  bool part_pair_ok(uint32_t rq, uint32_t va, uint32_t vb) const {
+    if (!part_cut) return true;
+    if (!(random_u32(seed, STREAM_PARTITION, rq, 0, 0) < part_cut))
+      return true;
+    return (random_u32(seed, STREAM_PARTITION, rq, 1, va) & 1u) ==
+           (random_u32(seed, STREAM_PARTITION, rq, 1, vb) & 1u);
+  }
+
+  bool open_edge(uint32_t rq, uint32_t src, uint32_t dst) const {
+    bool open = delivery_u32(seed, rq, src, dst) >= drop_cut;
+    if (!open && max_delay)
+      open = delayed_open(seed, rq, src, dst, drop_cut, max_delay);
+    return open;
+  }
+
+  // Edge-model uplink: sender i → its aggregator, phase ph.
+  bool up_edge(uint32_t ph, uint32_t i) const {
+    const uint32_t a = agg_of(i), rq = q[a];
+    return open_edge(rq, i, N + ph * K + a) &&
+           part_pair_ok(rq, i, N + a);
+  }
+  // §6b bcast uplink: the sender's one atomic broadcast draw (q, i, i).
+  bool up_bcast(uint32_t i) const {
+    const uint32_t a = agg_of(i), rq = q[a];
+    return open_edge(rq, i, i) && part_pair_ok(rq, i, N + a);
+  }
+  // Downlink: aggregator a → receiver j at the CURRENT round.
+  bool down(uint32_t ph, uint32_t a, uint32_t j) const {
+    if (!alive[a]) return false;
+    return open_edge(r, N + ph * K + a, j) && part_pair_ok(r, N + a, j);
+  }
+  // The factorized two-hop for an edge-model vote flight i → j.
+  bool two_hop(uint32_t ph, uint32_t i, uint32_t j) const {
+    return up_edge(ph, i) && down(ph, agg_of(i), j);
+  }
+};
+
 // SPEC §6c crash-recover transitions — the scalar twin of
 // ops/adversary.crash_transition. Both draws are pure counter
 // functions of (seed, round, node); only the down mask is history.
@@ -188,6 +272,10 @@ struct RaftSim {
   // SPEC §6c / §A.2 adversary knobs (0 = off).
   uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
   CrashAdv crash;
+  // SPEC §9 switch model (vote responses via K aggregators).
+  uint32_t net_switch = 0, n_agg = 0;
+  uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
+  AggNet agg;
 
   // Auto: the capped round queries only O(A·N) edges — edge-wise makes
   // it tractable at 100k nodes; the dense round touches ~every edge ~7
@@ -195,6 +283,16 @@ struct RaftSim {
   bool edge_net() const {
     if (delivery == DELIVERY_AUTO) return A > 0;
     return delivery == DELIVERY_EDGE;
+  }
+
+  // The SPEC §9 vote-response leg j → c: the flat §2 edge in the
+  // historic model, the factorized two-hop through j's aggregator
+  // under net_model="switch" (phase 0 = election vote responses).
+  // Receiver liveness is the caller's guard (P2c skips down tallies).
+  bool vote_leg(uint32_t j, uint32_t c) const {
+    if (!net_switch) return net.delivered(j, c);
+    if (crash.on && !crash.up[j]) return false;
+    return agg.two_hop(0, j, c);
   }
 
   // State, struct-of-arrays to mirror the array schema (SURVEY.md §7).
@@ -284,6 +382,9 @@ struct RaftSim {
     crash_prologue(r);
     net.begin_round(seed, N, r, drop_cut, part_cut, edge_net(), max_delay,
                     crash.up_mask());
+    if (net_switch)
+      agg.begin_round(seed, N, n_agg, r, drop_cut, part_cut, max_delay,
+                      agg_fail_cut, agg_stale_cut, agg_max_stale);
     std::vector<uint8_t> reset(N, 0);
 
     // ---- P0 churn: all leaders step down.
@@ -349,11 +450,12 @@ struct RaftSim {
         if (j == c) continue;
         if (dbl_grant() && !honest(j)) {
           // SPEC §3c equivocate: byz j responds to EVERY delivered
-          // candidate request, ignoring term/up-to-date checks.
-          if (was_cand[c] && net.delivered(c, j) && net.delivered(j, c))
+          // candidate request, ignoring term/up-to-date checks (the
+          // request leg stays flat; the response rides vote_leg, §9).
+          if (was_cand[c] && net.delivered(c, j) && vote_leg(j, c))
             ++votes;
         } else if ((!withhold() || honest(j)) && grant[j] == int32_t(c) &&
-                   net.delivered(j, c)) {
+                   vote_leg(j, c)) {
           ++votes;
         }
       }
@@ -478,6 +580,9 @@ struct RaftSim {
     crash_prologue(r);
     net.begin_round(seed, N, r, drop_cut, part_cut, edge_net(), max_delay,
                     crash.up_mask());
+    if (net_switch)
+      agg.begin_round(seed, N, n_agg, r, drop_cut, part_cut, max_delay,
+                      agg_fail_cut, agg_stale_cut, agg_max_stale);
     std::vector<uint8_t> reset(N, 0);
 
     // ---- P0 churn.
@@ -558,10 +663,10 @@ struct RaftSim {
         if (j == c) continue;
         if (dbl_grant() && !honest(j)) {
           // SPEC §3c equivocate: byz j responds to EVERY delivered
-          // active candidate request.
-          if (net.delivered(c, j) && net.delivered(j, c)) ++votes;
+          // active candidate request (response via vote_leg — SPEC §9).
+          if (net.delivered(c, j) && vote_leg(j, c)) ++votes;
         } else if ((!withhold() || honest(j)) && grant[j] == int32_t(c) &&
-                   net.delivered(j, c)) {
+                   vote_leg(j, c)) {
           ++votes;
         }
       }
@@ -727,6 +832,12 @@ struct PbftSim {
   // SPEC §6c / §A.2 adversary knobs (0 = off).
   uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
   CrashAdv crash;
+  // SPEC §9 switch model: P4/P5 vote tallies + P6 decide gossip via K
+  // aggregators (phases 0/1/2); P1 view sync and the P3 pre-prepare
+  // stay flat (control plane / one-sender traffic).
+  uint32_t net_switch = 0, n_agg = 0;
+  uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
+  AggNet agg;
 
   // The §6 dense tallies walk ~every (i, j) pair anyway, so the
   // materialized Net stays the auto choice for the edge fault model;
@@ -826,10 +937,15 @@ struct PbftSim {
       else
         net.begin_round(seed, N, r, drop_cut, part_cut, edge_net(),
                         max_delay, crash.up_mask());
-      if (bcast_fast())
+      if (net_switch) {
+        agg.begin_round(seed, N, n_agg, r, drop_cut, part_cut, max_delay,
+                        agg_fail_cut, agg_stale_cut, agg_max_stale);
+        round_switch(r);
+      } else if (bcast_fast()) {
         round_bcast_fast(r);
-      else
+      } else {
         round_direct(r);
+      }
     }
   }
 
@@ -1153,6 +1269,187 @@ struct PbftSim {
       else if (!reset[j]) timer[j] += 1;
     }
   }
+
+  // One SPEC §9 switch round (either fault model): P0/P1/P2/P3/P7 are
+  // round_direct's flat phases verbatim; the P4/P5 tallies and the P6
+  // decide gossip route through the K aggregators. Each aggregator
+  // combines its segment's live votes into (count, vmax, vmin) and
+  // SERVES (count, value) only when the segment is value-UNIFORM (a
+  // mixed segment is the switch-vs-replica inconsistency a receiver
+  // detects but cannot resolve — it serves nothing). Equivocating
+  // support is the per-ROUND stance in BOTH fault models (the switch
+  // dedups per-receiver claims) and rides any serving segment (its own
+  // segment included). Self votes never travel: a receiver counts
+  // itself locally and discounts its own switch-returned copy. Scalar
+  // twin of the engines' ops/aggregate.value_votes / min_id_votes.
+  void round_switch(uint32_t r) {
+    const uint32_t Q = 2 * f + 1;
+    const uint32_t K = agg.K;
+    std::vector<uint8_t> reset(N, 0), new_commit(N, 0);
+    std::vector<uint32_t> views_in;
+
+    // P0 churn.
+    if (churn_fires(seed, r, churn_cut))
+      for (uint32_t i = 0; i < N; ++i) {
+        if (crash.is_down(i)) continue;
+        view[i] += 1; timer[i] = 0; reset[i] = 1;
+      }
+
+    // P1 view catch-up (flat — view sync is control-plane traffic).
+    const std::vector<uint32_t> s_view = view;
+    for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
+      views_in.clear();
+      views_in.push_back(s_view[j]);
+      for (uint32_t i = 0; i < N; ++i)
+        if (i != j && honest(i) && del(r, i, j))
+          views_in.push_back(s_view[i]);
+      if (views_in.size() >= f + 1) {
+        std::nth_element(views_in.begin(), views_in.begin() + f,
+                         views_in.end(), std::greater<uint32_t>());
+        uint32_t vth = views_in[f];
+        if (vth > view[j]) { view[j] = vth; timer[j] = 0; reset[j] = 1; }
+      }
+    }
+
+    // P2 timeout.
+    for (uint32_t j = 0; j < N; ++j)
+      if (!crash.is_down(j) && timer[j] >= view_timeout) {
+        view[j] += 1; timer[j] = 0; reset[j] = 1;
+      }
+
+    // P3 pre-prepare (shared, flat).
+    phase_preprepare(r);
+
+    // Per-sender uplinks: the §6b model sends ONE atomic broadcast
+    // into the switch (shared by every phase); the edge model draws a
+    // per-phase uplink on the sender's aggregator vertex.
+    std::vector<uint8_t> up_ph[3];
+    for (uint32_t ph = 0; ph < 3; ++ph) up_ph[ph].assign(N, 0);
+    for (uint32_t i = 0; i < N; ++i) {
+      if (crash.on && !crash.up[i]) continue;  // down senders send nothing
+      if (fault_bcast) {
+        const uint8_t u = agg.up_bcast(i) ? 1 : 0;
+        up_ph[0][i] = up_ph[1][i] = up_ph[2][i] = u;
+      } else {
+        for (uint32_t ph = 0; ph < 3; ++ph)
+          up_ph[ph][i] = agg.up_edge(ph, i) ? 1 : 0;
+      }
+    }
+    // Per-round equivocation stances (value-blind switch support).
+    std::vector<uint8_t> eq_send(N, 0);
+    if (equiv && n_byz > 0)
+      for (uint32_t i = 0; i < N; ++i)
+        if (!honest(i) && stance(r, i)) eq_send[i] = 1;
+    // Per-(phase, segment) equivocating-support counts.
+    std::vector<uint32_t> eqc[3];
+    for (uint32_t ph = 0; ph < 3; ++ph) {
+      eqc[ph].assign(K, 0);
+      for (uint32_t i = 0; i < N; ++i)
+        if (eq_send[i] && up_ph[ph][i]) ++eqc[ph][agg.agg_of(i)];
+    }
+
+    const std::vector<uint8_t> s_seen = pp_seen;
+    const std::vector<uint32_t> s_val = pp_val;
+    std::vector<uint32_t> cnt(K), vmx(K), mid(K), mval(K);
+    std::vector<uint8_t> srv(K);
+
+    // Segment aggregates for one (phase, slot): live contributors are
+    // honest, uplink-delivered holders of `relevant`.
+    const auto aggregate = [&](uint32_t ph, uint32_t s,
+                               const std::vector<uint8_t>& relevant) {
+      std::fill(cnt.begin(), cnt.end(), 0);
+      bool first;
+      for (uint32_t a = 0; a < K; ++a) srv[a] = 0;
+      for (uint32_t a = 0; a < K; ++a) vmx[a] = 0;
+      std::vector<uint32_t> vmn(K, 0);
+      first = true;
+      for (uint32_t i = 0; i < N; ++i) {
+        if (!honest(i) || !relevant[at(i, s)] || !up_ph[ph][i]) continue;
+        const uint32_t a = agg.agg_of(i), v = s_val[at(i, s)];
+        if (cnt[a] == 0) { vmx[a] = v; vmn[a] = v; }
+        else { vmx[a] = std::max(vmx[a], v); vmn[a] = std::min(vmn[a], v); }
+        ++cnt[a];
+      }
+      (void)first;
+      for (uint32_t a = 0; a < K; ++a)
+        srv[a] = cnt[a] > 0 && vmx[a] == vmn[a];
+    };
+    // The switch-delivered count at receiver j (self excluded; own
+    // returned copy discounted by the caller's self flag).
+    const auto count_for = [&](uint32_t ph, uint32_t s, uint32_t j,
+                               bool own_contrib) -> uint32_t {
+      const uint32_t v = s_val[at(j, s)];
+      uint32_t c = 0;
+      for (uint32_t a = 0; a < K; ++a) {
+        if (!srv[a] || vmx[a] != v) continue;
+        if (!agg.down(ph, a, j)) continue;
+        c += cnt[a] + eqc[ph][a];
+      }
+      const uint32_t aj = agg.agg_of(j);
+      if (srv[aj] && vmx[aj] == v && agg.down(ph, aj, j)) {
+        if (own_contrib && up_ph[ph][j]) --c;         // own vote returned
+        if (eq_send[j] && up_ph[ph][j]) --c;          // own stance returned
+      }
+      return c;
+    };
+
+    for (uint32_t s = 0; s < S; ++s) {
+      // P4 prepare tally (value-matched; self counted locally).
+      aggregate(0, s, s_seen);
+      for (uint32_t j = 0; j < N; ++j) {
+        if (crash.is_down(j)) continue;
+        if (!s_seen[at(j, s)] || prepared[at(j, s)]) continue;
+        const bool own = honest(j) && s_seen[at(j, s)];
+        uint32_t c = (own ? 1 : 0) + count_for(0, s, j, own);
+        if (c >= Q) prepared[at(j, s)] = 1;
+      }
+      // P5 commit tally over post-P4 prepared.
+      aggregate(1, s, prepared);
+      for (uint32_t j = 0; j < N; ++j) {
+        if (crash.is_down(j)) continue;
+        if (!prepared[at(j, s)] || committed[at(j, s)]) continue;
+        const bool own = honest(j);  // prepared[at(j, s)] holds here
+        uint32_t c = (own ? 1 : 0) + count_for(1, s, j, own);
+        if (c >= Q) {
+          committed[at(j, s)] = 1;
+          dval[at(j, s)] = pp_val[at(j, s)];
+          new_commit[j] = 1;
+        }
+      }
+      // P6 decide gossip: each aggregator serves the MIN id of its
+      // live deciders + that decider's value; receivers adopt from the
+      // lowest id across delivered segments.
+      for (uint32_t a = 0; a < K; ++a) mid[a] = N;
+      for (uint32_t i = 0; i < N; ++i) {
+        if (!honest(i) || !committed[at(i, s)] || !up_ph[2][i]) continue;
+        const uint32_t a = agg.agg_of(i);
+        if (i < mid[a]) { mid[a] = i; mval[a] = dval[at(i, s)]; }
+      }
+      for (uint32_t j = 0; j < N; ++j) {
+        if (crash.is_down(j)) continue;
+        if (committed[at(j, s)]) continue;
+        uint32_t best = N, bv = 0;
+        for (uint32_t a = 0; a < K; ++a) {
+          if (mid[a] == N || mid[a] >= best) continue;
+          if (!agg.down(2, a, j)) continue;
+          best = mid[a]; bv = mval[a];
+        }
+        if (best < N) {
+          committed[at(j, s)] = 1;
+          dval[at(j, s)] = bv;
+          new_commit[j] = 1;
+        }
+      }
+    }
+
+    // P7 timer.
+    for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;  // SPEC §6c: frozen while down
+      if (new_commit[j]) timer[j] = 0;
+      else if (!reset[j]) timer[j] += 1;
+    }
+  }
 };
 
 // ---------------------------------------------------------------------------
@@ -1167,6 +1464,18 @@ struct PaxosSim {
   // SPEC §6c / §A.2 adversary knobs (0 = off).
   uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
   CrashAdv crash;
+  // SPEC §9 switch model: promise (phase 0) and accepted (phase 1)
+  // responses route through K aggregators; the request legs (prepare/
+  // accept/decide broadcasts) stay flat.
+  uint32_t net_switch = 0, n_agg = 0;
+  uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
+  AggNet agg;
+
+  bool resp_leg(uint32_t ph, uint32_t a, uint32_t p) const {
+    if (!net_switch) return net.delivered(a, p);
+    if (crash.on && !crash.up[a]) return false;
+    return agg.two_hop(ph, a, p);
+  }
 
   // Auto: the round only ever queries proposer↔acceptor edges — ~7·P·N
   // mixer evals edge-wise vs N² materialized — so the crossover sits at
@@ -1214,6 +1523,9 @@ struct PaxosSim {
             for (uint32_t s = 0; s < S; ++s) promised[at(i, s)] = 0;
       net.begin_round(seed, N, r, drop_cut, part_cut, edge_net(), max_delay,
                       crash.up_mask());
+      if (net_switch)
+        agg.begin_round(seed, N, n_agg, r, drop_cut, part_cut, max_delay,
+                        agg_fail_cut, agg_stale_cut, agg_max_stale);
       const bool churn = churn_fires(seed, r, churn_cut);
       for (uint32_t p = 0; p < P; ++p) {
         slot[p] = random_u32(seed, STREAM_VALUE, r, 1, p) % S;
@@ -1235,7 +1547,7 @@ struct PaxosSim {
               scratch[s] = std::max(scratch[s], bal[p]);
             }
           for (uint32_t p = 0; p < P; ++p) {
-            if (!net.delivered(p, a) || !net.delivered(a, p)) continue;
+            if (!net.delivered(p, a) || !resp_leg(0, a, p)) continue;
             uint32_t s = slot[p];
             // promise iff b > promised_old and b == max(promised_old, P_max)
             if (bal[p] > promised[at(a, s)] && bal[p] == scratch[s]) {
@@ -1273,7 +1585,7 @@ struct PaxosSim {
             }
           }
           for (uint32_t p = 0; p < P; ++p) {  // responses before application
-            if (!proceed[p] || !net.delivered(p, a) || !net.delivered(a, p))
+            if (!proceed[p] || !net.delivered(p, a) || !resp_leg(1, a, p))
               continue;
             uint32_t s = slot[p];
             if (bal[p] >= promised[at(a, s)] && bal[p] == scratch[s]) ++n_acc[p];
@@ -1318,6 +1630,10 @@ struct DposSim {
   // SPEC §6c / §A.1 / §A.2 adversary knobs (0 = off).
   uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0;
   uint32_t miss_cut = 0, max_delay = 0;
+  // SPEC §A.4 correlated producer suppression: one draw per
+  // (round / suppress_window, producer) — a suppressed producer misses
+  // EVERY slot scheduled inside the window.
+  uint32_t suppress_cut = 0, suppress_window = 16;
   CrashAdv crash;
 
   std::vector<uint32_t> chain_r, chain_p;  // [V*L]
@@ -1377,6 +1693,12 @@ struct DposSim {
       // (round, producer) so failures correlate with the schedule.
       if (miss_cut && random_u32(seed, STREAM_SLOTMISS, r, 0, p) < miss_cut)
         continue;
+      // SPEC §A.4 correlated suppression: window-keyed, so the outage
+      // persists across the producer's consecutive scheduled slots.
+      if (suppress_cut &&
+          random_u32(seed, STREAM_SUPPRESS, r / suppress_window, 0, p) <
+              suppress_cut)
+        continue;
       if (crash.is_down(p)) continue;  // SPEC §6c: down producer, no block
       bool part_active = random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
       uint32_t side_p = random_u32(seed, STREAM_PARTITION, r, 1, p) & 1u;
@@ -1420,6 +1742,10 @@ struct HotstuffSim {
   // SPEC §6c / §A.2 adversary knobs (0 = off).
   uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
   CrashAdv crash;
+  // SPEC §9 switch model (votes via K aggregators; phase 0).
+  uint32_t net_switch = 0, n_agg = 0;
+  uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
+  AggNet agg;
 
   // Global pacemaker + QC-chain state (the network's shared state —
   // forks are unreachable: a QC certifies one block per height and the
@@ -1466,6 +1792,9 @@ struct HotstuffSim {
     if (crash.on)
       for (uint32_t i = 0; i < N; ++i)
         if (crash.rec[i]) { view_[i] = 0; timer[i] = 0; }
+    if (net_switch)
+      agg.begin_round(seed, N, n_agg, r, drop_cut, part_cut, max_delay,
+                      agg_fail_cut, agg_stale_cut, agg_max_stale);
 
     // P0 churn: the view's leader skips its slot this round.
     const bool churn = churn_fires(seed, r, churn_cut);
@@ -1508,7 +1837,12 @@ struct HotstuffSim {
         // drop leg applies to the return edge.
         if (honest(j)) {
           bool vd = j == L;
-          if (!vd) {
+          if (!vd && net_switch) {
+            // SPEC §9: the vote routes through j's aggregator (the
+            // leader counts K pre-aggregated segments; scalar form =
+            // the factorized two-hop, phase 0).
+            vd = agg.two_hop(0, j, L);
+          } else if (!vd) {
             bool open = delivery_u32(seed, r, j, L) >= drop_cut;
             if (!open && max_delay)
               open = delayed_open(seed, r, j, L, drop_cut, max_delay);
@@ -1554,12 +1888,32 @@ struct HotstuffSim {
 
 namespace {
 
+// SPEC §9 config validation shared by the switch-capable adapters AND
+// the C ABI entry points (mirrors core/config.py: flat forbids the agg
+// knobs, switch needs 1 <= K <= N, the stale depth is bounded like the
+// §A.2 horizon). ONE rule, five call sites — a future bound change
+// edits exactly here.
+bool valid_switch(uint32_t net_switch, uint32_t n_aggregators,
+                  uint32_t n_nodes, uint32_t agg_fail_cut,
+                  uint32_t agg_stale_cut, uint32_t agg_max_stale) {
+  if (agg_max_stale < 1 || agg_max_stale > 8) return false;
+  if (!net_switch)
+    return n_aggregators == 0 && agg_fail_cut == 0 &&
+           agg_stale_cut == 0 && agg_max_stale == 1;
+  return n_aggregators >= 1 && n_aggregators <= n_nodes;
+}
+
+bool valid_switch(const SimConfig& c) {
+  return valid_switch(c.net_switch, c.n_aggregators, c.n_nodes,
+                      c.agg_fail_cut, c.agg_stale_cut, c.agg_max_stale);
+}
+
 class RaftEngine final : public Engine {
  public:
   const char* name() const override { return "raft"; }
   int run(const SimConfig& c) override {
     if (c.n_nodes == 0 || c.t_max <= c.t_min || c.max_active > c.n_nodes ||
-        c.oracle_delivery > DELIVERY_EDGE)
+        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c))
       return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.L = c.log_capacity; sim_.E = c.max_entries;
@@ -1571,6 +1925,9 @@ class RaftEngine final : public Engine {
     sim_.delivery = c.oracle_delivery;
     sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
     sim_.max_crashed = c.max_crashed; sim_.max_delay = c.max_delay;
+    sim_.net_switch = c.net_switch; sim_.n_agg = c.n_aggregators;
+    sim_.agg_fail_cut = c.agg_fail_cut; sim_.agg_stale_cut = c.agg_stale_cut;
+    sim_.agg_max_stale = c.agg_max_stale;
     sim_.run();
     return 0;
   }
@@ -1619,7 +1976,7 @@ class PbftEngine final : public SlotEngine<PbftSim> {
   const char* name() const override { return "pbft"; }
   int run(const SimConfig& c) override {
     if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f ||
-        c.oracle_delivery > DELIVERY_EDGE)
+        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c))
       return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity; sim_.f = c.f;
@@ -1631,6 +1988,9 @@ class PbftEngine final : public SlotEngine<PbftSim> {
     sim_.delivery = c.oracle_delivery;
     sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
     sim_.max_crashed = c.max_crashed; sim_.max_delay = c.max_delay;
+    sim_.net_switch = c.net_switch; sim_.n_agg = c.n_aggregators;
+    sim_.agg_fail_cut = c.agg_fail_cut; sim_.agg_stale_cut = c.agg_stale_cut;
+    sim_.agg_max_stale = c.agg_max_stale;
     sim_.run();
     return 0;
   }
@@ -1646,7 +2006,7 @@ class PaxosEngine final : public SlotEngine<PaxosSim> {
   const char* name() const override { return "paxos"; }
   int run(const SimConfig& c) override {
     if (c.n_nodes == 0 || c.log_capacity == 0 ||
-        c.oracle_delivery > DELIVERY_EDGE)
+        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c))
       return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity;
@@ -1656,6 +2016,9 @@ class PaxosEngine final : public SlotEngine<PaxosSim> {
     sim_.delivery = c.oracle_delivery;
     sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
     sim_.max_crashed = c.max_crashed; sim_.max_delay = c.max_delay;
+    sim_.net_switch = c.net_switch; sim_.n_agg = c.n_aggregators;
+    sim_.agg_fail_cut = c.agg_fail_cut; sim_.agg_stale_cut = c.agg_stale_cut;
+    sim_.agg_max_stale = c.agg_max_stale;
     sim_.run();
     return 0;
   }
@@ -1670,7 +2033,9 @@ class HotstuffEngine final : public SlotEngine<HotstuffSim> {
  public:
   const char* name() const override { return "hotstuff"; }
   int run(const SimConfig& c) override {
-    if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f) return 1;
+    if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f ||
+        !valid_switch(c))
+      return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity; sim_.f = c.f;
     sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
@@ -1678,6 +2043,9 @@ class HotstuffEngine final : public SlotEngine<HotstuffSim> {
     sim_.churn_cut = c.churn_cut;
     sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
     sim_.max_crashed = c.max_crashed; sim_.max_delay = c.max_delay;
+    sim_.net_switch = c.net_switch; sim_.n_agg = c.n_aggregators;
+    sim_.agg_fail_cut = c.agg_fail_cut; sim_.agg_stale_cut = c.agg_stale_cut;
+    sim_.agg_max_stale = c.agg_max_stale;
     sim_.run();
     return 0;
   }
@@ -1694,7 +2062,7 @@ class DposEngine final : public Engine {
   int run(const SimConfig& c) override {
     if (c.n_nodes == 0 || c.n_candidates == 0 || c.n_producers == 0 ||
         c.n_producers > c.n_candidates || c.n_candidates > c.n_nodes ||
-        c.epoch_len == 0)
+        c.epoch_len == 0 || c.net_switch || c.suppress_window == 0)
       return 1;
     sim_.seed = c.seed; sim_.V = c.n_nodes; sim_.R = c.n_rounds;
     sim_.L = c.log_capacity; sim_.C = c.n_candidates; sim_.K = c.n_producers;
@@ -1704,6 +2072,8 @@ class DposEngine final : public Engine {
     sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
     sim_.max_crashed = c.max_crashed;
     sim_.miss_cut = c.miss_cut; sim_.max_delay = c.max_delay;
+    sim_.suppress_cut = c.suppress_cut;
+    sim_.suppress_window = c.suppress_window;
     sim_.run();
     return 0;
   }
@@ -1761,6 +2131,9 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t recover_cut,    // SPEC §6c recovery cutoff
                   uint32_t max_crashed,    // SPEC §6c cap (0 = none)
                   uint32_t max_delay,      // SPEC §A.2 horizon (0 = off)
+                  uint32_t net_switch,     // SPEC §9 switch model
+                  uint32_t n_aggregators, uint32_t agg_fail_cut,
+                  uint32_t agg_stale_cut, uint32_t agg_max_stale,
                   uint32_t* out_commit,    // [N]
                   uint32_t* out_log_term,  // [N*L]
                   uint32_t* out_log_val,   // [N*L]
@@ -1768,6 +2141,9 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t* out_role) {    // [N]
   if (n_nodes == 0 || t_max <= t_min || max_active > n_nodes ||
       n_byzantine > n_nodes || oracle_delivery > 2 || max_delay > 16)
+    return 1;
+  if (!ctpu::valid_switch(net_switch, n_aggregators, n_nodes,
+                          agg_fail_cut, agg_stale_cut, agg_max_stale))
     return 1;
   ctpu::RaftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
@@ -1778,6 +2154,9 @@ int ctpu_raft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.delivery = oracle_delivery;
   sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
   sim.max_crashed = max_crashed; sim.max_delay = max_delay;
+  sim.net_switch = net_switch; sim.n_agg = n_aggregators;
+  sim.agg_fail_cut = agg_fail_cut; sim.agg_stale_cut = agg_stale_cut;
+  sim.agg_max_stale = agg_max_stale;
   sim.run();
   std::memcpy(out_commit, sim.commit.data(), sizeof(uint32_t) * n_nodes);
   std::memcpy(out_log_term, sim.log_term.data(),
@@ -1798,11 +2177,17 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t crash_cut, uint32_t recover_cut,  // SPEC §6c
                   uint32_t max_crashed,
                   uint32_t max_delay,        // SPEC §A.2 horizon (0 = off)
+                  uint32_t net_switch,     // SPEC §9 switch model
+                  uint32_t n_aggregators, uint32_t agg_fail_cut,
+                  uint32_t agg_stale_cut, uint32_t agg_max_stale,
                   uint8_t* out_committed,   // [N*S]
                   uint32_t* out_dval,       // [N*S]
                   uint32_t* out_view) {     // [N]
   if (n_nodes != 3 * f + 1 || n_byzantine > f || oracle_delivery > 2 ||
       max_delay > 16)
+    return 1;
+  if (!ctpu::valid_switch(net_switch, n_aggregators, n_nodes,
+                          agg_fail_cut, agg_stale_cut, agg_max_stale))
     return 1;
   ctpu::PbftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
@@ -1813,6 +2198,9 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.delivery = oracle_delivery;
   sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
   sim.max_crashed = max_crashed; sim.max_delay = max_delay;
+  sim.net_switch = net_switch; sim.n_agg = n_aggregators;
+  sim.agg_fail_cut = agg_fail_cut; sim.agg_stale_cut = agg_stale_cut;
+  sim.agg_max_stale = agg_max_stale;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_committed, sim.committed.data(), ns);
@@ -1828,12 +2216,18 @@ int ctpu_paxos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                    uint32_t crash_cut, uint32_t recover_cut,  // SPEC §6c
                    uint32_t max_crashed,
                    uint32_t max_delay,          // SPEC §A.2 (0 = off)
+                   uint32_t net_switch,     // SPEC §9 switch model
+                   uint32_t n_aggregators, uint32_t agg_fail_cut,
+                   uint32_t agg_stale_cut, uint32_t agg_max_stale,
                    uint32_t* out_learned_val,   // [N*S]
                    uint8_t* out_learned_mask,   // [N*S]
                    uint32_t* out_promised,      // [N*S]
                    uint32_t* out_acc_bal,       // [N*S]
                    uint32_t* out_acc_val) {     // [N*S]
   if (n_nodes == 0 || n_slots == 0 || oracle_delivery > 2 || max_delay > 16)
+    return 1;
+  if (!ctpu::valid_switch(net_switch, n_aggregators, n_nodes,
+                          agg_fail_cut, agg_stale_cut, agg_max_stale))
     return 1;
   ctpu::PaxosSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
@@ -1842,6 +2236,9 @@ int ctpu_paxos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.delivery = oracle_delivery;
   sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
   sim.max_crashed = max_crashed; sim.max_delay = max_delay;
+  sim.net_switch = net_switch; sim.n_agg = n_aggregators;
+  sim.agg_fail_cut = agg_fail_cut; sim.agg_stale_cut = agg_stale_cut;
+  sim.agg_max_stale = agg_max_stale;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_learned_val, sim.learned_val.data(), sizeof(uint32_t) * ns);
@@ -1860,13 +2257,15 @@ int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t max_crashed,
                   uint32_t miss_cut,        // SPEC §A.1 slot-miss cutoff
                   uint32_t max_delay,       // SPEC §A.2 horizon (0 = off)
+                  uint32_t suppress_cut,    // SPEC §A.4 correlated outages
+                  uint32_t suppress_window,
                   uint32_t* out_chain_r,    // [V*L]
                   uint32_t* out_chain_p,    // [V*L]
                   uint32_t* out_chain_len,  // [V]
                   int32_t* out_lib) {       // [V] SPEC §7 LIB, -1 = none
   if (n_nodes == 0 || n_candidates == 0 || n_producers == 0 ||
       n_producers > n_candidates || n_candidates > n_nodes ||
-      epoch_len == 0 || max_delay > 16)
+      epoch_len == 0 || max_delay > 16 || suppress_window == 0)
     return 1;
   ctpu::DposSim sim;
   sim.seed = seed; sim.V = n_nodes; sim.R = n_rounds; sim.L = log_capacity;
@@ -1875,6 +2274,7 @@ int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
   sim.max_crashed = max_crashed;
   sim.miss_cut = miss_cut; sim.max_delay = max_delay;
+  sim.suppress_cut = suppress_cut; sim.suppress_window = suppress_window;
   sim.run();
   size_t vl = size_t(n_nodes) * log_capacity;
   std::memcpy(out_chain_r, sim.chain_r.data(), sizeof(uint32_t) * vl);
@@ -1892,17 +2292,26 @@ int ctpu_hotstuff_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                       uint32_t crash_cut, uint32_t recover_cut,  // SPEC §6c
                       uint32_t max_crashed,
                       uint32_t max_delay,       // SPEC §A.2 (0 = off)
+                      uint32_t net_switch,     // SPEC §9 switch model
+                      uint32_t n_aggregators, uint32_t agg_fail_cut,
+                      uint32_t agg_stale_cut, uint32_t agg_max_stale,
                       uint8_t* out_committed,   // [N*S]
                       uint32_t* out_dval,       // [N*S]
                       uint32_t* out_clen,       // [N]
                       uint32_t* out_view) {     // [N]
   if (n_nodes != 3 * f + 1 || n_byzantine > f || max_delay > 16) return 1;
+  if (!ctpu::valid_switch(net_switch, n_aggregators, n_nodes,
+                          agg_fail_cut, agg_stale_cut, agg_max_stale))
+    return 1;
   ctpu::HotstuffSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
   sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
   sim.max_crashed = max_crashed; sim.max_delay = max_delay;
+  sim.net_switch = net_switch; sim.n_agg = n_aggregators;
+  sim.agg_fail_cut = agg_fail_cut; sim.agg_stale_cut = agg_stale_cut;
+  sim.agg_max_stale = agg_max_stale;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_committed, sim.committed.data(), ns);

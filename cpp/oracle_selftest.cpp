@@ -17,20 +17,24 @@ extern "C" {
 int ctpu_raft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t*, uint32_t*, uint32_t*, uint32_t*, uint32_t*);
 int ctpu_pbft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t, uint32_t, uint32_t,
                   uint8_t*, uint32_t*, uint32_t*);
 int ctpu_paxos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                    uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                   uint32_t, uint32_t*, uint8_t*,
+                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                   uint32_t*, uint8_t*,
                    uint32_t*, uint32_t*, uint32_t*);
 int ctpu_dpos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint32_t, uint32_t, uint32_t*, uint32_t*,
-                  uint32_t*, int32_t*);
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t*, uint32_t*, uint32_t*, int32_t*);
 int ctpu_hotstuff_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                      uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                       uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                       uint32_t, uint32_t, uint32_t, uint32_t,
                       uint8_t*, uint32_t*, uint32_t*, uint32_t*);
@@ -91,40 +95,46 @@ int main() {
     size_t W = N + 2 * size_t(N) * L + N + N;
     rc |= run_twice("raft", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
-                           0, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
+                           0, 0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
+                           o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // Capped engine (SPEC §3b): same shapes, max_active = 3.
     rc |= run_twice("raft-capped", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
-                           0, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
+                           0, 0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
+                           o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // SPEC §3c adversaries: withholding and double-granting minorities.
     rc |= run_twice("raft-byz-silent", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 2, 0,
-                           0, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
+                           0, 0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
+                           o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     rc |= run_twice("raft-byz-equiv", W, [&](uint32_t* o) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 2, 1,
-                           0, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
+                           0, 0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
+                           o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     // Edge-wise vs dense delivery: byte-identical on both engines.
     rc |= run_match("raft-delivery", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
-                           d, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
+                           d, 0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
+                           o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     rc |= run_match("raft-capped-delivery", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
-                           d, 0, 0, 0, 0, o, o + N, o + N + size_t(N) * L,
+                           d, 0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
+                           o, o + N, o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
@@ -132,14 +142,14 @@ int main() {
     // adversary-library mirror), dense vs edge delivery.
     rc |= run_match("raft-crash-delay", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0, 0,
-                           d, CRASH, REC, 2, 4, o, o + N,
+                           d, CRASH, REC, 2, 4, /*§9 flat*/ 0, 0, 0, 0, 1, o, o + N,
                            o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
     });
     rc |= run_match("raft-capped-crash", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0, 0,
-                           d, CRASH, REC, 0, 3, o, o + N,
+                           d, CRASH, REC, 0, 3, /*§9 flat*/ 0, 0, 0, 0, 1, o, o + N,
                            o + N + size_t(N) * L,
                            o + N + 2 * size_t(N) * L,
                            o + 2 * N + 2 * size_t(N) * L);
@@ -152,23 +162,27 @@ int main() {
     size_t W = (ns + 3) / 4 + ns + N;
     rc |= run_twice("pbft", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     rc |= run_twice("pbft-equiv", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // SPEC §6b broadcast-atomic fault model, with equivocation.
     rc |= run_twice("pbft-bcast", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, 0, 0, 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // §6 edge model: dense vs forced edge-wise delivery queries.
     rc |= run_match("pbft-delivery", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, d, 0, 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -176,13 +190,14 @@ int main() {
     // direct per-receiver definition (forced dense).
     rc |= run_match("pbft-bcast-agg", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d, 0, 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // §6b aggregate vs direct under §6c crash + §A.2 delay.
     rc |= run_match("pbft-bcast-crash", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d,
-                           CRASH, REC, 2, 3,
+                           CRASH, REC, 2, 3, /*§9 flat*/ 0, 0, 0, 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -195,14 +210,14 @@ int main() {
     size_t W = (ns + 3) / 4 + ns + N + N;
     rc |= run_twice("hotstuff", W, [&](uint32_t* o) {
       return ctpu_hotstuff_run(33, N, R, S, f, 8, 1, DROP, PART, CHURN,
-                               0, 0, 0, 0,
+                               0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
                                reinterpret_cast<uint8_t*>(o),
                                o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                o + (ns + 3) / 4 + ns + N);
     });
     rc |= run_twice("hotstuff-crash-delay", W, [&](uint32_t* o) {
       return ctpu_hotstuff_run(33, N, R, S, f, 8, 0, DROP, PART, CHURN,
-                               CRASH, REC, 2, 4,
+                               CRASH, REC, 2, 4, /*§9 flat*/ 0, 0, 0, 0, 1,
                                reinterpret_cast<uint8_t*>(o),
                                o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                o + (ns + 3) / 4 + ns + N);
@@ -213,12 +228,14 @@ int main() {
     size_t ns = size_t(N) * S;
     size_t W = ns + (ns + 3) / 4 + 3 * ns;
     rc |= run_twice("paxos", W, [&](uint32_t* o) {
-      return ctpu_paxos_run(55, N, R, S, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0, o,
+      return ctpu_paxos_run(55, N, R, S, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
+                            /*§9 flat*/ 0, 0, 0, 0, 1, o,
                             reinterpret_cast<uint8_t*>(o + ns), o + ns + (ns + 3) / 4,
                             o + ns + (ns + 3) / 4 + ns, o + ns + (ns + 3) / 4 + 2 * ns);
     });
     rc |= run_match("paxos-delivery", W, [&](uint32_t* o, uint32_t d) {
-      return ctpu_paxos_run(55, N, R, S, 2, DROP, PART, CHURN, d, 0, 0, 0, 0, o,
+      return ctpu_paxos_run(55, N, R, S, 2, DROP, PART, CHURN, d, 0, 0, 0, 0,
+                            /*§9 flat*/ 0, 0, 0, 0, 1, o,
                             reinterpret_cast<uint8_t*>(o + ns), o + ns + (ns + 3) / 4,
                             o + ns + (ns + 3) / 4 + ns, o + ns + (ns + 3) / 4 + 2 * ns);
     });
@@ -228,17 +245,91 @@ int main() {
     size_t vl = size_t(V) * L;
     size_t W = 2 * vl + 2 * V;  // chains + chain_len + lib
     rc |= run_twice("dpos", W, [&](uint32_t* o) {
-      return ctpu_dpos_run(33, V, R, L, C, K, EP, DROP, PART, CHURN, 0, 0, 0, 0, 0, o, o + vl,
+      return ctpu_dpos_run(33, V, R, L, C, K, EP, DROP, PART, CHURN, 0, 0, 0,
+                           0, 0, /*§A.4 off*/ 0, 16, o, o + vl,
                            o + 2 * vl,
                            reinterpret_cast<int32_t*>(o + 2 * vl + V));
     });
     // §A.1 slot miss + §A.2 delay + §6c crash composed.
     rc |= run_twice("dpos-adversary", W, [&](uint32_t* o) {
       return ctpu_dpos_run(33, V, R, L, C, K, EP, DROP, PART, CHURN,
-                           CRASH, REC, 5, MISS, 4, o, o + vl,
+                           CRASH, REC, 5, MISS, 4, /*§A.4*/ MISS, 24,
+                           o, o + vl,
                            o + 2 * vl,
                            reinterpret_cast<int32_t*>(o + 2 * vl + V));
     });
+  }
+  {
+    // SPEC §9 switch model: composed aggregator failure + stale state
+    // with drop/partition/churn (+ §6c crash, §A.2 delay) for every
+    // switch-capable protocol — determinism under sanitizers.
+    const uint32_t AGGF = 644245094u, AGGS = 1288490188u;  // ~15%, ~30%
+    {
+      const uint32_t N = 9, R = 64, L = 32, E = 24;
+      size_t W = N + 2 * size_t(N) * L + N + N;
+      rc |= run_twice("raft-switch", W, [&](uint32_t* o) {
+        return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 0, 0,
+                             0, 0, CRASH, REC, 2, 2,
+                             /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
+                             o, o + N, o + N + size_t(N) * L,
+                             o + N + 2 * size_t(N) * L,
+                             o + 2 * N + 2 * size_t(N) * L);
+      });
+      rc |= run_twice("raft-capped-switch", W, [&](uint32_t* o) {
+        return ctpu_raft_run(99, N, R, L, E, 3, 8, DROP, PART, CHURN, 3, 0,
+                             0, 0, 0, 0, 0, 0,
+                             /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
+                             o, o + N, o + N + size_t(N) * L,
+                             o + N + 2 * size_t(N) * L,
+                             o + 2 * N + 2 * size_t(N) * L);
+      });
+    }
+    {
+      const uint32_t f = 2, N = 3 * f + 1, R = 48, S = 16;
+      size_t ns = size_t(N) * S;
+      size_t W = (ns + 3) / 4 + ns + N;
+      rc |= run_twice("pbft-switch", W, [&](uint32_t* o) {
+        return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN,
+                             0, CRASH, REC, 2, 2,
+                             /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
+                             reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
+                             o + (ns + 3) / 4 + ns);
+      });
+      rc |= run_twice("pbft-bcast-switch", W, [&](uint32_t* o) {
+        return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN,
+                             0, 0, 0, 0, 2,
+                             /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
+                             reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
+                             o + (ns + 3) / 4 + ns);
+      });
+    }
+    {
+      const uint32_t N = 9, R = 32, S = 16;
+      size_t ns = size_t(N) * S;
+      size_t W = ns + (ns + 3) / 4 + 3 * ns;
+      rc |= run_twice("paxos-switch", W, [&](uint32_t* o) {
+        return ctpu_paxos_run(55, N, R, S, 0, DROP, PART, CHURN, 0,
+                              CRASH, REC, 2, 2,
+                              /*§9 switch*/ 1, 3, AGGF, AGGS, 3, o,
+                              reinterpret_cast<uint8_t*>(o + ns),
+                              o + ns + (ns + 3) / 4,
+                              o + ns + (ns + 3) / 4 + ns,
+                              o + ns + (ns + 3) / 4 + 2 * ns);
+      });
+    }
+    {
+      const uint32_t f = 2, N = 3 * f + 1, R = 96, S = 64;
+      size_t ns = size_t(N) * S;
+      size_t W = (ns + 3) / 4 + ns + N + N;
+      rc |= run_twice("hotstuff-switch", W, [&](uint32_t* o) {
+        return ctpu_hotstuff_run(33, N, R, S, f, 4, 1, DROP, PART, CHURN,
+                                 CRASH, REC, 2, 2,
+                                 /*§9 switch*/ 1, 2, AGGF, AGGS, 4,
+                                 reinterpret_cast<uint8_t*>(o),
+                                 o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
+                                 o + (ns + 3) / 4 + ns + N);
+      });
+    }
   }
   if (rc == 0) std::printf("selftest: ALL CLEAN\n");
   return rc;

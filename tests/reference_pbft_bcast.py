@@ -32,6 +32,7 @@ from consensus_tpu.core.config import Config
 from consensus_tpu.engines.pbft import PBFT_TELEMETRY, PbftState, pbft_init
 from consensus_tpu.engines.pbft_bcast import _extract, _pspec
 from consensus_tpu.network.runner import EngineDef
+from consensus_tpu.ops.aggregate import agg_counts
 from consensus_tpu.ops.adversary import (crash_counts, crash_transition,
                                          freeze_down)
 from consensus_tpu.ops.adversary import draw as _draw
@@ -298,9 +299,13 @@ def sorted_tally_round(cfg: Config, st: PbftState, r, *,
         return new
     cnt = lambda m: jnp.sum(m.astype(jnp.int32))  # noqa: E731
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    # SPEC §9 tail (zeros — the retired round predates the switch model
+    # and is only ever compared against flat-mode runs, where the
+    # production counters are identically zero too).
+    az = agg_counts()
     vec = jnp.stack([cnt(prep_new_s), cnt(prep_miss_s), cnt(commit_now_s),
                      cnt(commit_miss_s), cnt(adopt),
-                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz])
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az])
     return new, vec
 
 

@@ -356,6 +356,9 @@ SCENARIO_SHAPES = {
     "chained-commit-stall": Config(
         protocol="hotstuff", f=2, n_nodes=7, n_rounds=96,
         log_capacity=96, n_sweeps=2, seed=11),
+    "stale-aggregator-inconsistency": Config(
+        protocol="hotstuff", f=2, n_nodes=7, n_rounds=96,
+        log_capacity=96, n_sweeps=2, seed=11),
     # advsearch-discovered (tools/advsearch, scenarios/discovered.json):
     # the search's low-drop compound collapse — same tuned shape the
     # distiller verified at.

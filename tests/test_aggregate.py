@@ -1,0 +1,340 @@
+"""SPEC §9 in-network vote aggregation (net_model="switch") + the §A.4
+correlated DPoS producer-suppression stream.
+
+Three contracts:
+
+  * **Oracle parity.** Switch-model runs are byte-differential against
+    the C++ oracle (cpp/oracle.cpp AggNet) for every vote-counting
+    engine — raft (dense + §3b capped), pbft (edge + bcast), paxos,
+    hotstuff — including aggregator-failure/stale compositions with
+    drop/partition/churn/§6c crash/§A.2 delay/byzantine modes, and
+    through the one-program f-ladder (per-rung payloads byte-equal to
+    standalone switch runs).
+  * **Flat no-op.** net_model="flat" with the new Config fields at
+    their defaults is the PRE-SPEC-§9 program: bit-identity per engine
+    (old-style config JSON without the fields resolves to the same
+    digest) and the committed hlocheck fingerprints stay byte-stable
+    modulo the new fields (pinned by the hlocheck gate itself).
+  * **No silent ignores.** dpos rejects the switch; agg knobs reject
+    flat; suppression rejects non-dpos.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from consensus_tpu.core.config import Config
+from consensus_tpu.core import serialize
+from consensus_tpu.network import simulator
+
+SW = dict(net_model="switch", n_aggregators=3, agg_fail_rate=0.15,
+          agg_stale_rate=0.25, agg_max_stale=3)
+
+# Composed base adversary shared by the parity grid.
+ADV = dict(drop_rate=0.2, partition_rate=0.1, churn_rate=0.03,
+           max_delay_rounds=2, crash_prob=0.08, recover_prob=0.3)
+
+PARITY_CONFIGS = {
+    "raft-dense": dict(protocol="raft", n_nodes=9, n_rounds=64, n_sweeps=2,
+                       log_capacity=32, max_entries=24, seed=5, **ADV, **SW),
+    "raft-dense-byz-equiv": dict(protocol="raft", n_nodes=9, n_rounds=48,
+                                 n_sweeps=2, log_capacity=32, max_entries=24,
+                                 seed=7, drop_rate=0.15, n_byzantine=2,
+                                 byz_mode="equivocate", **SW),
+    "raft-dense-byz-silent": dict(protocol="raft", n_nodes=9, n_rounds=48,
+                                  n_sweeps=1, log_capacity=32,
+                                  max_entries=24, seed=8, drop_rate=0.15,
+                                  n_byzantine=2, byz_mode="silent", **SW),
+    "raft-capped": dict(protocol="raft", n_nodes=64, max_active=4,
+                        n_rounds=64, n_sweeps=2, log_capacity=32,
+                        max_entries=24, seed=11, max_crashed=5, **ADV, **SW),
+    "raft-capped-byz": dict(protocol="raft", n_nodes=32, max_active=4,
+                            n_rounds=48, n_sweeps=2, log_capacity=32,
+                            max_entries=24, seed=13, drop_rate=0.15,
+                            n_byzantine=5, byz_mode="equivocate", **SW),
+    "pbft-edge": dict(protocol="pbft", f=2, n_nodes=7, n_rounds=64,
+                      n_sweeps=2, log_capacity=16, seed=3, **ADV, **SW),
+    "pbft-edge-byz-equiv": dict(protocol="pbft", f=3, n_nodes=10,
+                                n_rounds=48, n_sweeps=2, log_capacity=16,
+                                seed=6, drop_rate=0.15, partition_rate=0.1,
+                                n_byzantine=2, byz_mode="equivocate", **SW),
+    "pbft-bcast": dict(protocol="pbft", fault_model="bcast", f=2, n_nodes=7,
+                       n_rounds=64, n_sweeps=2, log_capacity=16, seed=3,
+                       **ADV, **SW),
+    "pbft-bcast-byz-equiv": dict(protocol="pbft", fault_model="bcast", f=3,
+                                 n_nodes=10, n_rounds=48, n_sweeps=2,
+                                 log_capacity=16, seed=5, drop_rate=0.15,
+                                 partition_rate=0.1, n_byzantine=2,
+                                 byz_mode="equivocate", **SW),
+    "pbft-bcast-byz-silent": dict(protocol="pbft", fault_model="bcast", f=3,
+                                  n_nodes=10, n_rounds=48, n_sweeps=1,
+                                  log_capacity=16, seed=9, drop_rate=0.2,
+                                  n_byzantine=3, byz_mode="silent", **SW),
+    "paxos": dict(protocol="paxos", n_nodes=15, n_rounds=64, n_sweeps=2,
+                  log_capacity=24, seed=4, **ADV, **SW),
+    "paxos-capped-proposers": dict(protocol="paxos", n_nodes=21,
+                                   n_proposers=4, n_rounds=64, n_sweeps=2,
+                                   log_capacity=16, seed=6, drop_rate=0.25,
+                                   **SW),
+    "hotstuff": dict(protocol="hotstuff", f=2, n_nodes=7, n_rounds=64,
+                     n_sweeps=2, log_capacity=64, seed=3, n_byzantine=1,
+                     **ADV, **SW),
+}
+
+
+def _both(base: dict):
+    rt = simulator.run(Config(engine="tpu", **base), warmup=False)
+    rc = simulator.run(Config(engine="cpu", **base))
+    return rt, rc
+
+
+@pytest.mark.parametrize("name", sorted(PARITY_CONFIGS))
+def test_switch_oracle_parity(name):
+    rt, rc = _both(PARITY_CONFIGS[name])
+    assert rt.digest == rc.digest, f"{name}: switch run diverged"
+
+
+def test_switch_oracle_parity_500_nodes():
+    # The acceptance bound says N <= 2k; a ~500-node pbft-bcast run
+    # exercises real multi-segment geometry (K = 8 over 499 nodes).
+    base = dict(protocol="pbft", fault_model="bcast", f=166, n_nodes=499,
+                n_rounds=24, n_sweeps=1, log_capacity=8, seed=2,
+                drop_rate=0.1, partition_rate=0.05, net_model="switch",
+                n_aggregators=8, agg_fail_rate=0.1, agg_stale_rate=0.2,
+                agg_max_stale=2)
+    rt, rc = _both(base)
+    assert rt.digest == rc.digest
+
+
+def test_switch_k1_and_kn_geometry():
+    # K = 1 (one global aggregator) and K = N (one node per segment)
+    # are the degenerate segmentations most likely to break the
+    # pad/reshape math.
+    for k in (1, 9):
+        base = dict(protocol="raft", n_nodes=9, n_rounds=48, n_sweeps=1,
+                    log_capacity=32, max_entries=24, seed=21,
+                    drop_rate=0.2, net_model="switch", n_aggregators=k,
+                    agg_fail_rate=0.2, agg_stale_rate=0.3, agg_max_stale=2)
+        rt, rc = _both(base)
+        assert rt.digest == rc.digest, f"K={k} diverged"
+
+
+def test_fsweep_switch_rungs_equal_standalone():
+    from consensus_tpu.engines.pbft_sweep import (pbft_fsweep_run,
+                                                  rung_payloads)
+    fs = [1, 2, 3]
+    for fm in ("edge", "bcast"):
+        base = Config(protocol="pbft", fault_model=fm, f=1, n_nodes=4,
+                      n_rounds=48, n_sweeps=2, log_capacity=12, seed=7,
+                      drop_rate=0.15, partition_rate=0.1, churn_rate=0.02,
+                      max_delay_rounds=2, **SW)
+        pls = rung_payloads(pbft_fsweep_run(base, fs))
+        for k, f in enumerate(fs):
+            solo = dataclasses.replace(base, f=f, n_nodes=3 * f + 1,
+                                       seed=base.seed + k)
+            rt = simulator.run(solo, warmup=False)
+            rc = simulator.run(dataclasses.replace(solo, engine="cpu"))
+            assert rt.digest == rc.digest, (fm, f)
+            assert serialize.digest(pls[k]) == rt.digest, (fm, f)
+
+
+def test_fsweep_switch_rejects_oversized_k():
+    from consensus_tpu.engines.pbft_sweep import pbft_fsweep_run
+    base = Config(protocol="pbft", fault_model="bcast", f=5, n_nodes=16,
+                  n_rounds=16, n_sweeps=1, log_capacity=8, seed=1,
+                  net_model="switch", n_aggregators=8)
+    with pytest.raises(ValueError, match="n_aggregators"):
+        pbft_fsweep_run(base, [1, 3])  # rung f=1 has N=4 < K=8
+
+
+# --- flat is a compiled no-op ---------------------------------------------
+
+FLAT_SMALL = {
+    "raft": dict(protocol="raft", n_nodes=7, n_rounds=32, log_capacity=16,
+                 max_entries=12, drop_rate=0.1),
+    "raft-sparse": dict(protocol="raft", n_nodes=32, max_active=4,
+                        n_rounds=32, log_capacity=16, max_entries=12,
+                        drop_rate=0.1),
+    "pbft": dict(protocol="pbft", f=2, n_nodes=7, n_rounds=32,
+                 log_capacity=8, drop_rate=0.1),
+    "pbft-bcast": dict(protocol="pbft", fault_model="bcast", f=2, n_nodes=7,
+                       n_rounds=32, log_capacity=8, drop_rate=0.1),
+    "paxos": dict(protocol="paxos", n_nodes=9, n_rounds=32, log_capacity=8,
+                  drop_rate=0.1),
+    "dpos": dict(protocol="dpos", n_nodes=24, n_candidates=12,
+                 n_producers=4, n_rounds=32, log_capacity=48,
+                 drop_rate=0.1),
+    "hotstuff": dict(protocol="hotstuff", f=2, n_nodes=7, n_rounds=32,
+                     log_capacity=32, drop_rate=0.1),
+}
+
+
+@pytest.mark.parametrize("name", sorted(FLAT_SMALL))
+def test_flat_defaults_bit_identical(name):
+    """A config built from PRE-§9 JSON (none of the new fields present)
+    must resolve to the identical Config — and hence the identical
+    compiled program and digest — as one built today with the fields at
+    their defaults (the PR 10 compiled-no-op discipline; the compiled
+    side is pinned by the hlocheck fingerprints staying byte-stable)."""
+    base = FLAT_SMALL[name]
+    cfg = Config(engine="tpu", seed=3, n_sweeps=2, **base)
+    doc = json.loads(cfg.to_json())
+    for field in ("net_model", "n_aggregators", "agg_fail_rate",
+                  "agg_stale_rate", "agg_max_stale", "suppress_rate",
+                  "suppress_window"):
+        doc.pop(field)
+    old_style = Config.from_json(json.dumps(doc))
+    assert old_style == cfg
+    assert simulator.run(old_style, warmup=False).digest \
+        == simulator.run(cfg, warmup=False).digest
+
+
+def test_config_rejections():
+    ok = dict(protocol="raft", n_nodes=5)
+    with pytest.raises(ValueError, match="net_model"):
+        Config(**ok, net_model="mesh")
+    with pytest.raises(ValueError, match="producer row"):
+        Config(protocol="dpos", n_nodes=24, n_candidates=12,
+               n_producers=4, net_model="switch", n_aggregators=2)
+    with pytest.raises(ValueError, match="n_aggregators"):
+        Config(**ok, net_model="switch")          # K = 0
+    with pytest.raises(ValueError, match="n_aggregators"):
+        Config(**ok, net_model="switch", n_aggregators=6)  # K > N
+    with pytest.raises(ValueError, match="net_model='switch'"):
+        Config(**ok, agg_fail_rate=0.1)           # agg knob without switch
+    with pytest.raises(ValueError, match="net_model='switch'"):
+        Config(**ok, agg_max_stale=3)
+    with pytest.raises(ValueError, match="agg_max_stale"):
+        Config(**ok, net_model="switch", n_aggregators=2, agg_max_stale=9)
+    with pytest.raises(ValueError, match="suppress_rate"):
+        Config(**ok, suppress_rate=0.2)           # non-dpos suppression
+    with pytest.raises(ValueError, match="suppress_window"):
+        Config(protocol="dpos", n_nodes=24, n_candidates=12,
+               n_producers=4, suppress_window=8)  # window without rate
+
+
+def test_oracle_rejects_invalid_switch():
+    from consensus_tpu.oracle import bindings
+    cfg = Config(protocol="hotstuff", f=1, n_nodes=4, n_rounds=8,
+                 log_capacity=8, engine="cpu", net_model="switch",
+                 n_aggregators=2)
+    # Doctor an impossible K past the Python validation to prove the
+    # native layer rejects it too (no silent divergence).
+    bad = dataclasses.replace(cfg)
+    object.__setattr__(bad, "n_aggregators", 9)
+    with pytest.raises(RuntimeError):
+        bindings.hotstuff_run(bad)
+
+
+# --- telemetry -------------------------------------------------------------
+
+def test_agg_telemetry_counters():
+    stats: dict = {}
+    cfg = Config(protocol="hotstuff", f=2, n_nodes=7, n_rounds=64,
+                 n_sweeps=1, log_capacity=64, seed=11, engine="tpu",
+                 net_model="switch", n_aggregators=2, agg_fail_rate=0.4,
+                 agg_stale_rate=0.4, agg_max_stale=4)
+    r = simulator.run(cfg, warmup=False, stats=stats, telemetry=True)
+    tot = r.extras["telemetry"]["totals"]
+    assert tot["agg_down_rounds"] > 0
+    assert tot["stale_serves"] > 0
+    # Flat runs report the counters as zeros (the tail exists, inert).
+    r0 = simulator.run(dataclasses.replace(cfg, net_model="flat",
+                                           n_aggregators=0,
+                                           agg_fail_rate=0.0,
+                                           agg_stale_rate=0.0,
+                                           agg_max_stale=1),
+                       warmup=False, stats={}, telemetry=True)
+    tot0 = r0.extras["telemetry"]["totals"]
+    assert tot0["agg_down_rounds"] == 0 and tot0["stale_serves"] == 0
+
+
+# --- SPEC §A.4 correlated producer suppression -----------------------------
+
+SUPPRESS_BASE = dict(protocol="dpos", n_nodes=24, n_rounds=96, n_sweeps=2,
+                     log_capacity=96, n_candidates=12, n_producers=3,
+                     epoch_len=48, seed=5, drop_rate=0.2, churn_rate=0.02,
+                     miss_rate=0.1, max_delay_rounds=2, crash_prob=0.05,
+                     recover_prob=0.3, suppress_rate=0.3,
+                     suppress_window=24)
+
+
+def test_suppress_oracle_parity():
+    rt, rc = _both(SUPPRESS_BASE)
+    assert rt.digest == rc.digest
+
+
+def test_suppress_window_correlation():
+    """The §A.4 point: inside one window a producer's fate is ONE draw,
+    so a suppressed producer misses EVERY slot it is scheduled for in
+    the window — verified against the chain: no block from a
+    window-suppressed producer may appear in that window's rounds."""
+    from consensus_tpu.core import rng as crng
+    base = dict(SUPPRESS_BASE, drop_rate=0.0, churn_rate=0.0,
+                miss_rate=0.0, crash_prob=0.0, recover_prob=0.0,
+                max_delay_rounds=0, suppress_rate=0.5, n_sweeps=1)
+    cfg = Config(engine="tpu", **base)
+    out = simulator.run(cfg, warmup=False)
+    cut = cfg.suppress_cutoff
+    W = cfg.suppress_window
+    chain_r, chain_p = out.rec_a[0, 0], out.rec_b[0, 0]  # validator 0
+    n = int(out.counts[0, 0])
+    suppressed_blocks = [
+        (int(r), int(p)) for r, p in zip(chain_r[:n], chain_p[:n])
+        if int(crng.random_u32_np(cfg.seed, crng.STREAM_SUPPRESS,
+                                  int(r) // W, 0, int(p))) < cut]
+    assert suppressed_blocks == []
+
+
+def test_suppress_stalls_lib_below_iid_floor():
+    """RESILIENCE.md §8's negative result: iid slot-miss keying keeps
+    lib_ratio >= ~0.8. The correlated stream must do what iid cannot —
+    at a window spanning the epoch, a suppressed producer vanishes
+    from the suffix wholesale and LIB stalls well below that floor."""
+    base = dict(SUPPRESS_BASE, n_sweeps=4, suppress_rate=0.45,
+                suppress_window=48, miss_rate=0.0, crash_prob=0.0,
+                recover_prob=0.0, drop_rate=0.05, churn_rate=0.0,
+                max_delay_rounds=0)
+    r = simulator.run(Config(engine="tpu", **base), warmup=False)
+    lib = np.asarray(r.extras["lib"], dtype=np.int64)
+    head = np.asarray(r.counts, dtype=np.int64)
+    ratio = float((lib + 1).mean() / max(1.0, float(head.mean())))
+    assert ratio < 0.7, f"correlated suppression should stall LIB, got {ratio}"
+
+
+def test_knob_batch_rejects_gated_off_suppress_lane():
+    """run_knob_batch's gate-representativeness guard must cover the
+    new suppress_cutoff KNOB column: a base with suppression OFF leaves
+    the draw untraced, so a lane varying that column would be silently
+    ignored — the guard has to refuse it."""
+    import numpy as np
+
+    from consensus_tpu.core.knobs import KNOB_COLUMNS
+    from consensus_tpu.network import runner
+    from consensus_tpu.network.simulator import engine_def
+    cfg = Config(protocol="dpos", n_nodes=24, n_rounds=16, n_sweeps=1,
+                 log_capacity=32, n_candidates=12, n_producers=3,
+                 epoch_len=8, seed=1, drop_rate=0.2, telemetry_window=4)
+    assert not cfg.suppress_on
+    kmat = np.array([[getattr(cfg, c) for c in KNOB_COLUMNS]], np.uint32)
+    kmat[0, KNOB_COLUMNS.index("suppress_cutoff")] = 12345
+    with pytest.raises(ValueError, match="gates that adversary OFF"):
+        runner.run_knob_batch(cfg, engine_def(cfg),
+                              np.array([cfg.seed], np.uint32), kmat)
+
+
+# --- scenario --------------------------------------------------------------
+
+def test_stale_aggregator_scenario_passes_at_tuned_shape():
+    from consensus_tpu import scenarios
+    sc = scenarios.get("stale-aggregator-inconsistency")
+    cfg = Config(protocol="hotstuff", engine="tpu", n_sweeps=2, seed=11,
+                 **sc.tuned)
+    applied = scenarios.apply(cfg, sc)
+    assert applied.net_model == "switch"
+    r = simulator.run(applied, warmup=False, stats={}, telemetry=True)
+    verdict = scenarios.evaluate(sc, r)
+    assert verdict["passed"], verdict["checks"]

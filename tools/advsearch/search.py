@@ -36,6 +36,7 @@ RATE_CUTOFFS = {
     "crash_prob": "crash_cutoff",
     "recover_prob": "recover_cutoff",
     "miss_rate": "miss_cutoff",
+    "suppress_rate": "suppress_cutoff",
     "attack_rate": "attack_cutoff",
 }
 
@@ -126,10 +127,16 @@ SPACES: dict[str, Space] = {s.name: s for s in (
         base=Config(protocol="dpos", n_nodes=24, log_capacity=96,
                     n_candidates=12, n_producers=3, epoch_len=48,
                     drop_rate=0.3, miss_rate=0.1, max_delay_rounds=4,
-                    churn_rate=0.01, **_ADV),
+                    churn_rate=0.01, suppress_rate=0.1,
+                    suppress_window=48, **_ADV),
         knobs=(KnobRange("miss_rate", 0.05, 0.50),
                KnobRange("drop_rate", 0.05, 0.60),
-               KnobRange("churn_rate", 0.0, 0.10))),
+               KnobRange("churn_rate", 0.0, 0.10),
+               # SPEC §A.4: the correlated (window-keyed) suppression
+               # stream the §8 negative iid result asked for — the
+               # window spans the whole epoch (48), so one draw
+               # removes a producer from the suffix wholesale.
+               KnobRange("suppress_rate", 0.0, 0.60))),
     Space(
         name="raft-elections",
         description="Raft liveness under composed loss/partition/churn/"

@@ -140,13 +140,21 @@ HOTSTUFF_SMOKE = ["-m", "consensus_tpu", "--scenario",
                   "--f", "2", "--rounds", "96", "--log-capacity", "96",
                   "--sweeps", "2", "--seed", "11", "--platform", "cpu"]
 
+# The SPEC §9 switch-delivery smoke: votes through in-network
+# aggregators under the STREAM_AGG failure/stale fault axes — QC
+# starvation and chained-commit stall bounded by the flight recorder.
+SWITCH_SMOKE = ["-m", "consensus_tpu", "--scenario",
+                "stale-aggregator-inconsistency", "--protocol", "hotstuff",
+                "--f", "2", "--rounds", "96", "--log-capacity", "96",
+                "--sweeps", "2", "--seed", "11", "--platform", "cpu"]
+
 
 def layer_scenarios(_: argparse.Namespace) -> str:
     import importlib.util
     if importlib.util.find_spec("jax") is None:
         return "SKIP (jax not installed)"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    for smoke in (SCENARIO_SMOKE, HOTSTUFF_SMOKE):
+    for smoke in (SCENARIO_SMOKE, HOTSTUFF_SMOKE, SWITCH_SMOKE):
         if _run([sys.executable] + smoke, env=env):
             return "FAIL"
     return "ok"

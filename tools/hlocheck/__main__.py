@@ -72,6 +72,15 @@ def run_checks(only: list[str] | None = None, update: bool = False) -> int:
         from consensus_tpu.network import simulator
         eng = simulator.engine_def(tgt.cfg)
         con = cons[eng.name]
+        if tgt.contract_override:
+            import dataclasses as _dc
+            for k in ("sort_budget", "cumsum_budget"):
+                if tgt.contract_override.get(k, 0) > getattr(con, k):
+                    print(f"hlocheck: {tgt.name}: contract_override may "
+                          f"only TIGHTEN {k} (engine ceiling "
+                          f"{getattr(con, k)})", file=sys.stderr)
+                    return 2
+            con = _dc.replace(con, **tgt.contract_override)
         # f-ladder targets are ONE dispatch (no chunked cross-dispatch
         # carry), so their donation contract is trivially zero leaves.
         # Flight-recorder targets donate the telemetry accumulator +

@@ -56,6 +56,14 @@ class Target:
     name: str
     cfg: Config
     variants: tuple[Variant, ...]
+    # Per-target EngineContract field overrides (e.g. the -switch
+    # targets' TIGHTENED sort/cumsum ceilings: the SPEC §9 switch round
+    # replaces the pbft-bcast sorted-space machinery with segment
+    # reduces, so its budget pins to 0/0 while the engine's flat
+    # declaration keeps its own ceiling). Budgets may only TIGHTEN
+    # here — tools/hlocheck/__main__ applies them via
+    # dataclasses.replace and refuses a loosening override.
+    contract_override: dict | None = None
     # Non-None = an f-LADDER target: lower the one-program padded sweep
     # (engines/pbft_sweep.fsweep_lower over these rungs) instead of the
     # chunked round loop. A ladder is ONE dispatch — no cross-dispatch
@@ -114,6 +122,17 @@ PBFT_BCAST_FLIGHT = dataclasses.replace(FLAGSHIP_CONFIGS["pbft-100k-bcast"],
                                         telemetry_window=8)
 
 
+# SPEC §9 switch-model flagship targets: the flagship shapes re-lowered
+# under net_model="switch" with the full fault surface compiled in
+# (nonzero agg_fail/agg_stale so the STREAM_AGG machinery is part of
+# the pinned program). K = 8 aggregators (divides the 100k populations
+# exactly; the 10k paxos shape pads by reshape).
+def _switch(cfg: Config) -> Config:
+    return dataclasses.replace(cfg, net_model="switch", n_aggregators=8,
+                               agg_fail_rate=0.01, agg_stale_rate=0.01,
+                               agg_max_stale=4)
+
+
 def targets() -> tuple[Target, ...]:
     F = FLAGSHIP_CONFIGS
     return (
@@ -138,6 +157,18 @@ def targets() -> tuple[Target, ...]:
                 Variant("node2x4", (2, 4), "strict", "node"),
                 Variant("node1x8", (1, 8), "strict", "node"))),
         Target("pbft-1k-dense", PBFT_1K_DENSE, (SINGLE,)),
+        # --- SPEC §9 switch-model flagships ------------------------------
+        # pbft-bcast: the switch round DROPS the payload sort and the
+        # run-count cumsums outright (segment sum/max/min + uniformity
+        # replace sorted space) — the ceiling tightens to 0/0.
+        Target("pbft-100k-bcast-switch", _switch(F["pbft-100k-bcast"]),
+               (SINGLE,),
+               contract_override=dict(sort_budget=0, cumsum_budget=0)),
+        Target("paxos-10kx10k-switch", _switch(F["paxos-10kx10k"]),
+               (SINGLE,)),
+        Target("raft-100k-switch", _switch(F["raft-100k"]), (SINGLE,)),
+        Target("hotstuff-100k-switch", _switch(F["hotstuff-100k"]),
+               (SINGLE,)),
         Target("hotstuff-1k", HOTSTUFF_1K,
                (SINGLE,
                 Variant("node2x4", (2, 4), "bounded", "node"),

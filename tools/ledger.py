@@ -416,6 +416,20 @@ def main(argv=None) -> int:
 
     log(f"{len(doc['rows'])} rows, {len(doc['series'])} series, "
         f"{doc['n_cost_cards']} cost cards -> {out}")
+    # A BENCH_r*.json capture that contributes no MEASURED row (failed
+    # round, unparseable JSON, or no benchmark line) is invisible to
+    # every series verdict — the bench trajectory silently ends there
+    # unless someone hand-cross-references the raw capture. Say so.
+    measured = {r["source"] for r in doc["rows"]
+                if r["kind"] == "driver-bench" and r["ok"]
+                and r["steps_per_sec"]}
+    for fname in sorted(glob.glob(str(repo / "BENCH_r*.json"))):
+        name = pathlib.Path(fname).name
+        if name not in measured:
+            log(f"WARN {name}: capture present but no measured row "
+                "references it — this round is invisible to the "
+                "series verdicts (failed round or unparseable "
+                "benchmark line; inspect the raw capture)")
     for s in doc["stale_rows"]:
         log(f"STALE {s['name']} ({s['source']}): {s['note']}")
     for key, s in doc["series"].items():

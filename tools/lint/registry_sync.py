@@ -48,6 +48,7 @@ CHECK = "registry"
 
 ENGINES_GLOB = "consensus_tpu/engines/*.py"
 ADVERSARY = "consensus_tpu/ops/adversary.py"
+AGGREGATE = "consensus_tpu/ops/aggregate.py"
 VALIDATOR = "tools/validate_trace.py"
 SPLIT_KINDS = {"persistent", "volatile", "meta"}
 FREEZE_FNS = {"freeze_down", "_freeze"}
@@ -94,8 +95,9 @@ def _names_violations(repo: Repo, *, suffix: str, var: str, kind: str,
                           f"no {var} registry found")]
     registry, reg_line = got
     env: dict[str, tuple] = {}
-    if repo.exists(ADVERSARY):
-        env.update(_module_str_tuples(repo.tree(ADVERSARY), {}))
+    for shared in (ADVERSARY, AGGREGATE):
+        if repo.exists(shared):
+            env.update(_module_str_tuples(repo.tree(shared), {}))
     engine_names: set[str] = set()
     errs: list[Violation] = []
     for rel in repo.glob(ENGINES_GLOB):

@@ -43,11 +43,15 @@ TELEMETRY_COUNTERS = frozenset({
     "blocks_appended", "missed_appends", "producer_rotations", "churn_slots",
     # dpos per-producer slot faults (SPEC §A.1)
     "missed_slots",
+    # dpos correlated producer suppression (SPEC §A.4)
+    "suppressed_slots",
     # hotstuff (SPEC §7b; view_changes is shared with pbft above)
     "qc_formed", "blocks_committed", "commits_learned",
     "proposals_delivered", "votes_counted",
     # crash-recover adversary (SPEC §6c, every engine)
     "crashes", "recoveries", "nodes_down",
+    # in-network vote aggregation (SPEC §9, every switch-capable engine)
+    "agg_down_rounds", "stale_serves",
 })
 
 # Every flight-recorder protocol-latency histogram any engine may record
