@@ -43,6 +43,9 @@ _FLAG_FIELDS = {
     "agg_fail_rate": ("agg_fail_rate", 0.0),
     "agg_stale_rate": ("agg_stale_rate", 0.0),
     "agg_max_stale": ("agg_max_stale", 1),
+    "agg_byz": ("agg_byz", 0),
+    "agg_poison_rate": ("agg_poison_rate", 0.0),
+    "byz_uplink_rate": ("byz_uplink_rate", 0.0),
     "attack": ("attack", "none"),
     "attack_rate": ("attack_rate", 1.0),
     "attack_target": ("attack_target", 0),
@@ -66,7 +69,8 @@ _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
                "miss_rate": float, "suppress_rate": float,
                "attack": str, "attack_rate": float,
                "net_model": str, "agg_fail_rate": float,
-               "agg_stale_rate": float}
+               "agg_stale_rate": float, "agg_poison_rate": float,
+               "byz_uplink_rate": float}
 
 # Config fields with NO native-CLI flag (cpp/consensus_sim.cpp): TPU-
 # engine execution/adversary knobs. The native front door still reaches

@@ -103,6 +103,19 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     agg_fail_rate: float = 0.0
     agg_stale_rate: float = 0.0
     agg_max_stale: int = 1       # stale depth bound, in [1, 8]
+    # SPEC §9b poisoned aggregation (the vote-certificate byzantine
+    # model): the last agg_byz of the K aggregator vertices are
+    # byzantine — per (round, phase-qualified vertex) they serve a
+    # FORGED combine claiming full segment support with
+    # agg_poison_rate. Independently, each byzantine REPLICA (the
+    # n_byzantine set) lies to its switch vertex about its own vote
+    # with byz_uplink_rate per round (count paths: claims a vote it
+    # never cast; value paths: claims a forged value, killing segment
+    # uniformity). Both axes draw STREAM_POISON; mirrored in
+    # cpp/oracle.cpp.
+    agg_byz: int = 0             # byzantine aggregators (ids >= K - agg_byz)
+    agg_poison_rate: float = 0.0
+    byz_uplink_rate: float = 0.0
 
     # SPEC §A.4 correlated DPoS producer suppression (dpos only;
     # mirrored): one draw per (round // suppress_window, producer), so
@@ -177,13 +190,11 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
                 f"n_byzantine is a pbft/raft/hotstuff adversary "
                 f"(SPEC §6/§3c/§7b); {self.protocol} would silently "
                 "ignore it")
-        if self.protocol == "hotstuff" and self.byz_mode != "silent":
-            raise ValueError(
-                "hotstuff models only the silent byzantine minority "
-                "(SPEC §7b: votes are threshold counts at the leader — "
-                "an equivocation stance has no per-value tally to "
-                "poison); byz_mode='equivocate' would silently behave "
-                "as 'silent'")
+        # byz_mode='equivocate' is supported for hotstuff since the
+        # vote-certificate PR (SPEC §7c): the leader's vote tally is
+        # per value-id, so a byzantine leader can serve per-receiver
+        # certificates and fork a QC — the old "threshold counts have
+        # no per-value tally to poison" rejection is lifted.
         if self.byz_mode not in ("silent", "equivocate"):
             raise ValueError(f"unknown byz_mode {self.byz_mode!r}")
         if self.fault_model not in ("edge", "bcast"):
@@ -244,12 +255,45 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
                 raise ValueError(
                     "net_model='switch' requires 1 <= n_aggregators <= "
                     f"n_nodes, got K={self.n_aggregators} N={self.n_nodes}")
+            if not (0 <= self.agg_byz <= self.n_aggregators):
+                raise ValueError(
+                    "agg_byz must be in [0, n_aggregators] (SPEC §9b: "
+                    "the byzantine aggregators are the last agg_byz "
+                    f"vertex ids), got {self.agg_byz} with "
+                    f"K={self.n_aggregators}")
+            if self.agg_poison_rate > 0:
+                if self.agg_byz == 0:
+                    raise ValueError(
+                        "agg_poison_rate > 0 requires agg_byz > 0 (SPEC "
+                        "§9b: only a byzantine aggregator serves forged "
+                        "combines) — it would be silently ignored")
+                if self.protocol not in ("pbft", "hotstuff"):
+                    raise ValueError(
+                        "agg_poison_rate is the SPEC §9b forged-combine "
+                        "axis of the BFT vote engines (pbft, hotstuff); "
+                        f"{self.protocol} would silently ignore it")
+            if self.byz_uplink_rate > 0:
+                if self.protocol not in ("pbft", "hotstuff"):
+                    raise ValueError(
+                        "byz_uplink_rate is the SPEC §9b byzantine-"
+                        "uplink axis of the BFT vote engines (pbft, "
+                        f"hotstuff); {self.protocol} would silently "
+                        "ignore it")
+                if self.n_byzantine == 0:
+                    raise ValueError(
+                        "byz_uplink_rate > 0 requires n_byzantine > 0 "
+                        "(SPEC §9b: only a byzantine replica lies to "
+                        "its switch vertex) — it would be silently "
+                        "ignored")
         else:
             bad = [n for n, v, d in (
                 ("n_aggregators", self.n_aggregators, 0),
                 ("agg_fail_rate", self.agg_fail_rate, 0.0),
                 ("agg_stale_rate", self.agg_stale_rate, 0.0),
-                ("agg_max_stale", self.agg_max_stale, 1)) if v != d]
+                ("agg_max_stale", self.agg_max_stale, 1),
+                ("agg_byz", self.agg_byz, 0),
+                ("agg_poison_rate", self.agg_poison_rate, 0.0),
+                ("byz_uplink_rate", self.byz_uplink_rate, 0.0)) if v != d]
             if bad:
                 raise ValueError(
                     f"{', '.join(bad)} require net_model='switch' "
@@ -339,6 +383,14 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
         return prob_threshold_u32(self.agg_stale_rate)
 
     @property
+    def agg_poison_cutoff(self) -> int:
+        return prob_threshold_u32(self.agg_poison_rate)
+
+    @property
+    def byz_uplink_cutoff(self) -> int:
+        return prob_threshold_u32(self.byz_uplink_rate)
+
+    @property
     def suppress_cutoff(self) -> int:
         return prob_threshold_u32(self.suppress_rate)
 
@@ -376,6 +428,16 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
         return self.agg_stale_cutoff > 0
 
     @property
+    def agg_poison_on(self) -> bool:
+        """SPEC §9b static gate: poison-free switch configs compile the
+        PR-15 switch program byte-for-byte."""
+        return self.agg_byz > 0 and self.agg_poison_cutoff > 0
+
+    @property
+    def uplink_lies_on(self) -> bool:
+        return self.n_byzantine > 0 and self.byz_uplink_cutoff > 0
+
+    @property
     def suppress_on(self) -> bool:
         return self.suppress_cutoff > 0
 
@@ -392,6 +454,8 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             "attack": self.attack_cutoff,
             "agg_fail": self.agg_fail_cutoff,
             "agg_stale": self.agg_stale_cutoff,
+            "agg_poison": self.agg_poison_cutoff,
+            "byz_uplink": self.byz_uplink_cutoff,
             "suppress": self.suppress_cutoff,
         }
         return json.dumps(d, indent=2)

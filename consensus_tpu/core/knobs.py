@@ -40,7 +40,13 @@ from .config import Config
 # attack_target (a node id, also u32 on device).
 KNOB_COLUMNS = ("drop_cutoff", "partition_cutoff", "churn_cutoff",
                 "crash_cutoff", "recover_cutoff", "miss_cutoff",
-                "suppress_cutoff", "attack_cutoff", "attack_target")
+                "suppress_cutoff", "attack_cutoff", "attack_target",
+                # SPEC §9b vote-certificate byzantine knobs: both feed
+                # ops/aggregate's `_lt()` u32 compares, so they trace
+                # exactly like the delivery cutoffs. Their gates
+                # (agg_poison_on / uplink_lies_on) stay static on the
+                # base, per the gate/value split above.
+                "agg_poison_cutoff", "byz_uplink_cutoff")
 
 
 class KnobView:

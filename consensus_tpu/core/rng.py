@@ -68,6 +68,18 @@ STREAM_ATTACK = np.uint32(0xBB67AE85)    # per round targeted-attack activation
 # delivery pattern — a pure re-draw, §A.2-style, no queue rides the
 # carry), 2 = the stale depth draw d in [1, agg_max_stale]. Mirrored.
 STREAM_AGG = np.uint32(0x510E527F)       # per (round, subdraw, aggregator)
+# SPEC §9b poisoned in-network aggregation (net_model="switch"): the
+# vote-certificate byzantine axes of the switch layer — c0 selects the
+# subdraw: 0 = poisoned-serve activation for one (round, aggregator
+# vertex) (a byzantine aggregator serves a forged combine claiming full
+# segment support), 1 = byzantine-uplink lie for one (round, node) (a
+# byzantine replica lies to its switch vertex about its own vote),
+# 2 = the forged value a lying node serves (bitcast to i32, the same
+# 32-bit payload discipline as STREAM_VALUE blocks).
+# c1 carries the aggregator's phase-qualified vertex index (ph*K + a,
+# the same identity agg_ids assigns) for c0=0 and the node id for
+# c0=1/2. Mirrored scalar-for-scalar in cpp/oracle.cpp.
+STREAM_POISON = np.uint32(0x6A09E667)    # per (round, subdraw, vertex_or_node)
 # SPEC §A.4 correlated DPoS producer suppression: one draw per
 # (window, producer) with window = round // suppress_window, so a
 # suppressed producer misses EVERY slot scheduled inside the window —
@@ -106,6 +118,7 @@ STREAM_KEYS = {
     "STREAM_DELAY": ("origin_round", "delay", "edge"),  # via the §A.2 mixer
     "STREAM_ATTACK": ("round", None, None),
     "STREAM_AGG": ("round", "subdraw", "aggregator"),  # c0: 0=fail 1=stale 2=depth
+    "STREAM_POISON": ("round", "subdraw", "vertex_or_node"),  # c0: 0=serve 1=lie 2=val
     "STREAM_SUPPRESS": ("window", "subdraw", "producer"),  # c0: 0 (reserved)
     "STREAM_SEARCH": ("generation", "subdraw", "index"),
 }

@@ -55,31 +55,45 @@ import jax.numpy as jnp
 
 from ..core import rng
 from ..core.config import Config
-from ..ops.adversary import (CRASH_TELEMETRY, bitcast_i32, crash_counts,
-                             crash_transition, delayed_open, freeze_down)
+from ..ops.adversary import (CRASH_TELEMETRY, SAFETY_TELEMETRY, bitcast_i32,
+                             crash_counts, crash_transition, delayed_open,
+                             freeze_down, safety_counts)
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import draw as _draw
-from ..ops.aggregate import (AGG_TELEMETRY, agg_counts, agg_ids, agg_round,
-                             downlink, seg_sum, uplink_edge)
+from ..ops.aggregate import (AGG_TELEMETRY, agg_counts, agg_ids, agg_poison,
+                             agg_round, downlink, poison_count, seg_sum,
+                             seg_widths, take_seg, uplink_edge, uplink_lies)
 from ..ops.flight import bucket_counts
+
+# SPEC §7c fork-certificate table depth: at most this many FORKED QCs
+# (two conflicting quorums in one view) are value-tracked per run; later
+# forks still count in telemetry but their deceived sets are not
+# materialized in decided logs. Static so the carry stays O(N + S + F);
+# mirrored as a compile-time constant in cpp/oracle.cpp.
+FORK_TABLE = 8
 
 
 class HotstuffState(NamedTuple):
-    seed: jnp.ndarray     # [] uint32
-    gview: jnp.ndarray    # [] i32 — pacemaker view (global per sweep)
-    gtimer: jnp.ndarray   # [] i32 — rounds spent in the current view
-    b1_v: jnp.ndarray     # [] i32 — newest QC: view (-1 = none)
-    b1_h: jnp.ndarray     # [] i32 — newest QC: height (-1 = none)
-    b2_v: jnp.ndarray     # [] i32 — parent QC (the locked block)
-    b2_h: jnp.ndarray     # [] i32
-    b3_v: jnp.ndarray     # [] i32 — grandparent QC
-    b3_h: jnp.ndarray     # [] i32
-    gcommit: jnp.ndarray  # [] i32 — globally committed chain length
-    chain_v: jnp.ndarray  # [S] i32 — view that certified height s (-1)
-    view: jnp.ndarray     # [N] i32 — last view node i synced to
-    timer: jnp.ndarray    # [N] i32 — rounds since node i saw progress
-    clen: jnp.ndarray     # [N] i32 — committed length node i learned
-    down: jnp.ndarray     # [N] bool — SPEC §6c crashed mask
+    seed: jnp.ndarray       # [] uint32
+    gview: jnp.ndarray      # [] i32 — pacemaker view (global per sweep)
+    gtimer: jnp.ndarray     # [] i32 — rounds spent in the current view
+    b1_v: jnp.ndarray       # [] i32 — newest QC: view (-1 = none)
+    b1_h: jnp.ndarray       # [] i32 — newest QC: height (-1 = none)
+    b2_v: jnp.ndarray       # [] i32 — parent QC (the locked block)
+    b2_h: jnp.ndarray       # [] i32
+    b3_v: jnp.ndarray       # [] i32 — grandparent QC
+    b3_h: jnp.ndarray       # [] i32
+    gcommit: jnp.ndarray    # [] i32 — globally committed chain length
+    chain_v: jnp.ndarray    # [S] i32 — view that certified height s (-1)
+    chain_vid: jnp.ndarray  # [S] i32 — §7c value-id certified at height s
+    fvec: jnp.ndarray       # [N] i32 — bit k: node deceived at fork entry k
+    ftab_v: jnp.ndarray     # [FORK_TABLE] i32 — fork entry: certifying view
+    ftab_h: jnp.ndarray     # [FORK_TABLE] i32 — fork entry: height
+    fnum: jnp.ndarray       # [] i32 — fork entries recorded (<= FORK_TABLE)
+    view: jnp.ndarray       # [N] i32 — last view node i synced to
+    timer: jnp.ndarray      # [N] i32 — rounds since node i saw progress
+    clen: jnp.ndarray       # [N] i32 — committed length node i learned
+    down: jnp.ndarray       # [N] bool — SPEC §6c crashed mask
 
 
 # Compiled-program contract (tools/hlocheck): the linear-BFT claim,
@@ -113,6 +127,15 @@ CRASH_SPLIT = {
     "b3_h": "meta",
     "gcommit": "meta",
     "chain_v": "meta",
+    # §7c certificate twin: the fork table is network-abstract history
+    # (like chain_v), and fvec — though per-node — only records facts
+    # about DELIVERED proposals (deceived requires pdel, which already
+    # excludes down nodes), so none of it moves while a node is crashed.
+    "chain_vid": "meta",
+    "fvec": "meta",
+    "ftab_v": "meta",
+    "ftab_h": "meta",
+    "fnum": "meta",
     "view": "volatile",
     "timer": "volatile",
     "clen": "persistent",
@@ -127,7 +150,9 @@ HOTSTUFF_TELEMETRY = ("qc_formed",            # rounds forming a QC (0/1)
                       "proposals_delivered",  # Σ receivers of the round
                       "votes_counted",        # votes the leader counted
                       ) + CRASH_TELEMETRY \
-                      + AGG_TELEMETRY         # SPEC §9 (zeros when flat)
+                      + AGG_TELEMETRY \
+                      + SAFETY_TELEMETRY      # SPEC §7c/§9 (zeros unless
+                      #                         equivocate / poisoned)
 
 # Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
 # recorder"):
@@ -141,13 +166,15 @@ HOTSTUFF_TELEMETRY = ("qc_formed",            # rounds forming a QC (0/1)
 HOTSTUFF_LATENCY = ("view_change_wait_rounds", "chain_commit_lag_rounds")
 
 
-def _block_val(seed, chain_v, slots):
+def _block_val(seed, chain_v, slots, sub=5):
     """Block value at (certifying view, height) — SPEC §7b:
     bitcast_i32(draw(STREAM_VALUE, view, 5, height)); pure counter
     function, so decided values need no [N, S] state anywhere (the
-    oracle recomputes the identical u32). Broadcasts over inputs."""
+    oracle recomputes the identical u32). Broadcasts over inputs.
+    SPEC §7c: an equivocating leader's SECOND block variant for the
+    same (view, height) is the sibling subdraw 6 — `sub` selects."""
     return bitcast_i32(_draw(seed, rng.STREAM_VALUE,
-                             jnp.asarray(chain_v).astype(jnp.uint32), 5,
+                             jnp.asarray(chain_v).astype(jnp.uint32), sub,
                              jnp.asarray(slots).astype(jnp.uint32)))
 
 
@@ -187,7 +214,18 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
     uL = L.astype(jnp.uint32)
     honest = idx < (N - cfg.n_byzantine)   # SPEC §3c-style silent byz
     h_next = st.b1_h + 1
-    proposing = ~churn & (L < N - cfg.n_byzantine) & (h_next < S)
+    # SPEC §7c: under byz_mode="equivocate" a byzantine leader DOES
+    # propose — two block variants for the same (view, height), each
+    # receiver shown one (per-receiver value-id e_j below). Under the
+    # default silent mode a byzantine leader skips its view, exactly as
+    # before (`equiv` is a Python bool: the flat/silent program is
+    # unchanged bit for bit).
+    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
+    byzL = L >= jnp.int32(N - cfg.n_byzantine)
+    if equiv:
+        proposing = ~churn & (h_next < S)
+    else:
+        proposing = ~churn & ~byzL & (h_next < S)
     if crash_on:
         proposing &= ~down[L]
 
@@ -226,19 +264,97 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
     # stale one re-serves a shifted round's delivery pattern) become
     # view-liveness attacks.
     vote = pdel & honest
+    if equiv:
+        # §7c per-receiver value-id: which variant the (byzantine)
+        # leader showed node j — draw(STREAM_EQUIV, round, leader, j)&1,
+        # the same sup keying the pbft family uses for per-receiver
+        # claims. Honest leaders pin every receiver to variant 0.
+        evid = jnp.where(byzL,
+                         (_draw(seed, rng.STREAM_EQUIV, ur, uL, uidx)
+                          & jnp.uint32(1)).astype(jnp.int32),
+                         0)
+        # Byzantine REPLICAS under equivocate vote for BOTH variants
+        # (the maximal double-vote adversary) — silent-mode byz never
+        # vote at all.
+        voteb = pdel & ~honest
     if switch:
         aggst = agg_round(cfg, seed, ur)
-        sids = agg_ids(N, cfg.n_aggregators)
+        K_agg = cfg.n_aggregators
+        sids = agg_ids(N, K_agg)
         up0 = uplink_edge(cfg, seed, aggst, 0)
-        contrib = vote & (idx != L) & up0
-        seg = seg_sum(contrib.astype(jnp.int32), sids, cfg.n_aggregators)
-        down0 = downlink(cfg, seed, ur, aggst, 0, jnp.reshape(L, (1,)))
-        cnt = (vote[L].astype(jnp.int32)
-               + jnp.sum(jnp.where(down0[:, 0], seg, 0)))
+        if crash_on:
+            # vote/voteb already fold ~down via pdel; the fold here
+            # kills a CRASHED liar's §9b uplink claim too (§6c: down
+            # nodes send nothing, forged or not).
+            up0 &= ~down
+        down0 = downlink(cfg, seed, ur, aggst, 0, jnp.reshape(L, (1,)))[:, 0]
+        # §9b poisoned combines: a byzantine aggregator serves a forged
+        # full-segment-population count — for BOTH variant queries under
+        # equivocate, which is exactly how a poisoned switch vertex
+        # forges a forked QC without real double votes.
+        pz0 = agg_poison(cfg, seed, ur, 0)
+        wid = seg_widths(jnp.ones(N, bool), sids, K_agg) \
+            if pz0 is not None else None
+        # §9b uplink lies: a byzantine node claims a vote to its switch
+        # vertex regardless of delivery (and, under equivocate, for both
+        # variants — it's a claim, not a pinned value). The forged-value
+        # payload is count-path-irrelevant for hotstuff.
+        lie, _fv = uplink_lies(cfg, seed, ur, ~honest)
+
+        def _served(segx):
+            srv = jnp.where(down0, segx, 0)
+            if pz0 is not None:
+                srv = jnp.where(down0 & pz0, wid, srv)
+            return jnp.sum(srv)
+
+        if pz0 is not None:
+            # Leader's own aggregator poisoned+delivered: the forged
+            # width already counts L's slot — don't add the local vote.
+            own = take_seg((pz0 & down0).astype(jnp.int32), sids,
+                           K_agg)[L].astype(bool)
+
+        def _count(sup, self_sup):
+            contrib = sup & (idx != L) & up0
+            seg = seg_sum(contrib.astype(jnp.int32), sids, K_agg)
+            s = self_sup.astype(jnp.int32)
+            if pz0 is not None:
+                s = jnp.where(own, 0, s)
+            return s + _served(seg)
+
+        if equiv:
+            claim = (voteb | lie) if lie is not None else voteb
+            sup0 = (vote & (evid == 0)) | claim
+            sup1 = (vote & (evid == 1)) | claim
+            cnt0 = _count(sup0, sup0[L])
+            cnt1 = _count(sup1, sup1[L])
+        else:
+            sup = (vote | lie) if lie is not None else vote
+            cnt = _count(sup, vote[L])
     else:
-        vdel = vote & ((idx == L) | open_v)
-        cnt = jnp.sum(vdel.astype(jnp.int32))
-    qc = proposing & (cnt >= Q)
+        pz0 = None
+        if equiv:
+            vd0 = ((vote & (evid == 0)) | voteb) & ((idx == L) | open_v)
+            vd1 = ((vote & (evid == 1)) | voteb) & ((idx == L) | open_v)
+            cnt0 = jnp.sum(vd0.astype(jnp.int32))
+            cnt1 = jnp.sum(vd1.astype(jnp.int32))
+        else:
+            vdel = vote & ((idx == L) | open_v)
+            cnt = jnp.sum(vdel.astype(jnp.int32))
+    if equiv:
+        # §7c per-value QC tally: each variant needs its own quorum.
+        # BOTH reaching Q in one view is a FORKED QC — the safety
+        # violation classic HotStuff's signature checks exclude and
+        # this byzantine model deliberately re-admits. The canonical
+        # chain prefers variant 0 (deterministic tie-break, mirrored
+        # in the oracle).
+        qc0 = proposing & (cnt0 >= Q)
+        qc1 = proposing & (cnt1 >= Q)
+        qc = qc0 | qc1
+        forked = qc0 & qc1
+        vid = jnp.where(qc0, jnp.int32(0), jnp.int32(1))
+        cnt = cnt0 + cnt1   # telemetry: total votes the leader counted
+    else:
+        qc = proposing & (cnt >= Q)
 
     # ---- P3 QC-chain shift + chained 3-chain commit: the new QC is
     # the prepare phase of its block, promotes its parent to
@@ -255,6 +371,27 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
     consec = (b3_v >= 0) & (b1_v == b2_v + 1) & (b2_v == b3_v + 1)
     gcommit = jnp.where(qc & consec,
                         jnp.maximum(st.gcommit, b3_h + 1), st.gcommit)
+
+    # ---- §7c fork-certificate table: on a forked QC, record (view,
+    # height) in the next free slot and set that slot's bit for every
+    # honest receiver the leader showed the NON-canonical variant —
+    # those nodes durably believe the sibling block sits at this
+    # height, which _extract materializes as conflicting decided
+    # values. O(N + F) carry, no [N, S] tensor.
+    if equiv:
+        chain_vid = jnp.where((sarange == h_next) & qc, vid, st.chain_vid)
+        deceived = pdel & honest & (evid == 1)
+        can = forked & (st.fnum < FORK_TABLE)
+        hot = (jnp.arange(FORK_TABLE, dtype=jnp.int32) == st.fnum) & can
+        ftab_v = jnp.where(hot, st.gview, st.ftab_v)
+        ftab_h = jnp.where(hot, h_next, st.ftab_h)
+        fbit = jnp.left_shift(jnp.int32(1),
+                              jnp.minimum(st.fnum, FORK_TABLE - 1))
+        fvec = jnp.where(can & deceived, st.fvec | fbit, st.fvec)
+        fnum = st.fnum + can.astype(jnp.int32)
+    else:
+        chain_vid, fvec = st.chain_vid, st.fvec
+        ftab_v, ftab_h, fnum = st.ftab_v, st.ftab_h, st.fnum
 
     # ---- P4 learning: the proposal carries the pacemaker view and the
     # commit state as of proposal time, so every receiver syncs its
@@ -278,18 +415,33 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
         view, timer, clen = freeze_down(down, frozen, (view, timer, clen))
 
     new = HotstuffState(seed, gview, gtimer, b1_v, b1_h, b2_v, b2_h,
-                        b3_v, b3_h, gcommit, chain_v, view, timer, clen,
-                        down)
+                        b3_v, b3_h, gcommit, chain_v, chain_vid, fvec,
+                        ftab_v, ftab_h, fnum, view, timer, clen, down)
     if not telem:
         return new
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
-    az = agg_counts(aggst) if switch else agg_counts()
+    az = agg_counts(aggst, poison_count(aggst, pz0)) if switch \
+        else agg_counts()
+    if equiv:
+        # §7c conflicting commit indices: a deceived node's durable
+        # prefix crossed a recorded fork height this round — from here
+        # on its decided log disagrees with the canonical chain at that
+        # height. Static FORK_TABLE-deep loop, all counts on device.
+        conf = jnp.zeros((), jnp.int32)
+        for k in range(FORK_TABLE):
+            inw = ((jnp.int32(k) < fnum) & (ftab_h[k] >= st.clen)
+                   & (ftab_h[k] < new.clen))
+            conf += jnp.sum((((fvec >> k) & 1).astype(bool)
+                             & inw).astype(jnp.int32))
+        sz = safety_counts(forked, conf)
+    else:
+        sz = safety_counts()
     vec = jnp.stack([qc.astype(jnp.int32),
                      gcommit - st.gcommit,
                      jnp.sum(new.clen - st.clen),
                      to.astype(jnp.int32),
                      jnp.sum(pdel.astype(jnp.int32)),
-                     cnt, *cz, *az])
+                     cnt, *cz, *az, *sz])
     if not flight:
         return new, vec
     lat = jnp.stack([
@@ -305,6 +457,9 @@ def hotstuff_init(cfg: Config, seed) -> HotstuffState:
     return HotstuffState(
         jnp.asarray(seed, jnp.uint32), z, z, none, none, none, none,
         none, none, z, jnp.full((S,), -1, jnp.int32),
+        jnp.zeros(S, jnp.int32), jnp.zeros(N, jnp.int32),
+        jnp.full((FORK_TABLE,), -1, jnp.int32),
+        jnp.full((FORK_TABLE,), -1, jnp.int32), z,
         jnp.zeros(N, jnp.int32), jnp.zeros(N, jnp.int32),
         jnp.zeros(N, jnp.int32), jnp.zeros(N, bool))
 
@@ -326,11 +481,27 @@ def _extract(st: HotstuffState) -> dict:
     S = st.chain_v.shape[-1]
     sarange = jnp.arange(S, dtype=jnp.int32)
     committed = sarange[None, None, :] < st.clen[..., None]
-    vals = _block_val(st.seed[..., None], st.chain_v, sarange[None, :])
-    dval = jnp.where(committed, vals[:, None, :], 0)
+    v0 = _block_val(st.seed[..., None], st.chain_v, sarange[None, :])
+    v1 = _block_val(st.seed[..., None], st.chain_v, sarange[None, :], sub=6)
+    base = jnp.where(st.chain_vid == 1, v1, v0)
+    dval = jnp.where(committed, base[..., None, :], 0)
+    # §7c deceived overlays: at each recorded fork, a node holding that
+    # entry's fvec bit committed the SIBLING variant (subdraw 6 — the
+    # canonical side of a fork is always variant 0). Static
+    # FORK_TABLE-deep loop; the per-node divergence is exactly what the
+    # oracle differential + safety assertions observe.
+    for k in range(FORK_TABLE):
+        ok = jnp.int32(k) < st.fnum
+        hh = st.ftab_h[..., k]
+        alt = _block_val(st.seed, st.ftab_v[..., k], hh, sub=6)
+        hit = (((st.fvec >> k) & 1).astype(bool)[..., None]
+               & (sarange == hh[..., None, None])
+               & ok[..., None, None] & committed)
+        dval = jnp.where(hit, alt[..., None, None], dval)
     return {"committed": committed, "dval": dval,
             "clen": st.clen, "gcommit": st.gcommit,
-            "chain_v": st.chain_v, "view": st.view}
+            "chain_v": st.chain_v, "view": st.view,
+            "fvec": st.fvec, "fnum": st.fnum}
 
 
 def _pspec(cfg: Config) -> HotstuffState:
@@ -339,7 +510,9 @@ def _pspec(cfg: Config) -> HotstuffState:
     g, v = P(), P(ND)
     return HotstuffState(seed=g, gview=g, gtimer=g, b1_v=g, b1_h=g,
                          b2_v=g, b2_h=g, b3_v=g, b3_h=g, gcommit=g,
-                         chain_v=P(None), view=v, timer=v, clen=v, down=v)
+                         chain_v=P(None), chain_vid=P(None), fvec=v,
+                         ftab_v=P(None), ftab_h=P(None), fnum=g,
+                         view=v, timer=v, clen=v, down=v)
 
 
 _ENGINE = None

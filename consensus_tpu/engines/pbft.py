@@ -20,9 +20,9 @@ import numpy as np
 
 from ..core import rng
 from ..core.config import Config
-from ..ops.adversary import (CRASH_TELEMETRY, crash_counts,
-                             crash_transition, freeze_down)
-from ..ops.aggregate import AGG_TELEMETRY, agg_counts
+from ..ops.adversary import (CRASH_TELEMETRY, SAFETY_TELEMETRY, crash_counts,
+                             crash_transition, freeze_down, safety_counts)
+from ..ops.aggregate import AGG_TELEMETRY, agg_counts, poison_count
 from .raft import _delivery, _draw, _i32, _lt
 
 
@@ -127,7 +127,8 @@ PBFT_TELEMETRY = ("prepare_quorums",   # (node, slot) newly prepared
                   "commits_adopted",   # committed via decide gossip
                   "view_changes",      # Σ per-node view advance
                   ) + CRASH_TELEMETRY \
-                  + AGG_TELEMETRY      # SPEC §9 (zeros when flat)
+                  + AGG_TELEMETRY \
+                  + SAFETY_TELEMETRY   # SPEC §7c (zeros when byz off)
 
 # Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
 # recorder"; shared with the §6b bcast kernel):
@@ -258,12 +259,25 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     # per-receiver claims).
     switch = cfg.switch_on
     if switch:
-        from ..ops.aggregate import (agg_ids, agg_round, downlink,
-                                     downlink_self, min_id_votes,
-                                     uplink_edge, value_votes)
+        from ..ops.aggregate import (agg_ids, agg_poison, agg_round,
+                                     downlink, downlink_self, min_id_votes,
+                                     seg_widths, uplink_edge, uplink_lies,
+                                     value_votes)
         K_agg = cfg.n_aggregators
         aggst = agg_round(cfg, seed, ur)
         sids = agg_ids(N, K_agg)
+        # SPEC §9b poisoned aggregation (None / static no-op when off):
+        # forged-combine draws are per vote PHASE (the byzantine vertex
+        # equivocates between P4 and P5); the uplink lie is one claim
+        # per (round, node) shared by both phases. P6's min-id decide
+        # gossip is NOT poisonable — the decide message carries the
+        # decider's identity, a claim the switch cannot forge without
+        # it being attributable (SPEC §9b).
+        pz4 = agg_poison(cfg, seed, ur, 0)
+        pz5 = agg_poison(cfg, seed, ur, 1)
+        wid = seg_widths(jnp.ones(N, bool), sids, K_agg) \
+            if pz4 is not None else None
+        lie, fval = uplink_lies(cfg, seed, ur, ~honest)
         if equiv:
             stance = (_draw(seed, rng.STREAM_EQUIV, ur,
                             idx.astype(jnp.uint32),
@@ -276,7 +290,8 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         dn0 = downlink_self(cfg, seed, ur, aggst, 0)
         c4 = value_votes(pp_val, honest[:, None] & pp_seen, up0, down0,
                          dn0, sids, K_agg,
-                         eq_up=(byz & stance & up0) if equiv else None)
+                         eq_up=(byz & stance & up0) if equiv else None,
+                         lie=lie, lie_val=fval, poison=pz4, widths=wid)
         pcount = c4 + (honest[:, None] & pp_seen).astype(jnp.int32)
     else:
         val_eq = pp_val[:, None, :] == pp_val[None, :, :]              # [i, j, s]
@@ -302,7 +317,8 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         dn1 = downlink_self(cfg, seed, ur, aggst, 1)
         c5 = value_votes(pp_val, honest[:, None] & prepared, up1, down1,
                          dn1, sids, K_agg,
-                         eq_up=(byz & stance & up1) if equiv else None)
+                         eq_up=(byz & stance & up1) if equiv else None,
+                         lie=lie, lie_val=fval, poison=pz5, widths=wid)
         ccount = c5 + (honest[:, None] & prepared).astype(jnp.int32)
     else:
         ccount = jnp.sum(d_self_h[:, :, None] & prepared[:, None, :] & val_eq,
@@ -355,10 +371,32 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     # node's view to 0, and a raw sum would let that cancel real
     # advances (identical to the plain delta when crashes are off —
     # views never decrease otherwise).
-    az = agg_counts(aggst) if switch else agg_counts()
+    az = agg_counts(aggst, poison_count(aggst, pz4, pz5)) if switch \
+        else agg_counts()
+    # SPEC §7c safety invariants, reduced from the round's own tallies:
+    # forked_qc — slots where this round's commit quorums certified
+    # CONFLICTING values at honest nodes; conflict_commits — per-round
+    # gauge of slots where two honest nodes hold committed with
+    # different decided values. Static zeros unless a byzantine axis
+    # that can actually violate agreement is on.
+    unsafe = equiv or cfg.agg_poison_on or cfg.uplink_lies_on
+    if unsafe:
+        imin32, imax32 = jnp.iinfo(jnp.int32).min, jnp.iinfo(jnp.int32).max
+        nw = commit_now & honest[:, None]
+        forked = (jnp.any(nw, axis=0)
+                  & (jnp.max(jnp.where(nw, pp_val, imin32), axis=0)
+                     != jnp.min(jnp.where(nw, pp_val, imax32), axis=0)))
+        cm = committed & honest[:, None]
+        conflicts = (jnp.any(cm, axis=0)
+                     & (jnp.max(jnp.where(cm, dval, imin32), axis=0)
+                        != jnp.min(jnp.where(cm, dval, imax32), axis=0)))
+        sz = safety_counts(forked, conflicts)
+    else:
+        sz = safety_counts()
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
-                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az])
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az,
+                     *sz])
     if not flight:
         return new, vec
     from ..ops.flight import bucket_counts

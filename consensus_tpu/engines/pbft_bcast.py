@@ -51,8 +51,9 @@ import jax.numpy as jnp
 
 from ..core import rng
 from ..core.config import Config
-from ..ops.adversary import crash_counts, crash_transition, freeze_down
-from ..ops.aggregate import agg_counts
+from ..ops.adversary import (crash_counts, crash_transition, freeze_down,
+                             safety_counts)
+from ..ops.aggregate import agg_counts, poison_count
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import bitcast_i32 as _i32
@@ -230,7 +231,7 @@ def _table_count(vals, tv, tc):
 
 def _aggregate_tallies(pp_val, pp_seen, prepared, committed, honest, bcast,
                        Q, m: int, *, side=None, part_active=None,
-                       eq_send=None, up=None):
+                       extra=None, up=None):
     """The shared §6b P4+P5 aggregate machinery — ONE payload sort,
     per-(slot, side) top-``m`` run tables, elementwise delivery, with
     the P4 → P5 chain running through the same tables in sorted space
@@ -241,10 +242,14 @@ def _aggregate_tallies(pp_val, pp_seen, prepared, committed, honest, bcast,
     ``Q`` may be traced (the ladder's per-lane 2f+1); ``m`` is the
     static table width (:func:`_table_width`, maxed over rungs in the
     ladder). ``side``/``part_active`` are None on the static
-    no-partition path; ``eq_send`` (byz & bcast & stance) is None
-    without equivocators; ``up`` is the §6c receiver mask (None when
-    crashes are off — down SENDERS are already outside every count via
-    the bcast fold).
+    no-partition path; ``extra`` is the PER-RECEIVER equivocating
+    support count ([N] i32 — SPEC §7c: byz stances are per (sender,
+    receiver), so the caller reduces its sup grid with the broadcast,
+    self-exclusion and partition filters already folded; still
+    value-independent, so one count per receiver serves every slot) —
+    None without equivocators; ``up`` is the §6c receiver mask (None
+    when crashes are off — down SENDERS are already outside every
+    count via the bcast fold).
 
     Returns ``(prep_hit, prepared2, commit_now, c5)`` in original node
     order — callers derive telemetry (prep_new/miss, commit_miss) and
@@ -256,21 +261,11 @@ def _aggregate_tallies(pp_val, pp_seen, prepared, committed, honest, bcast,
     def side_ok(b):
         return ~part_active | (side == b)
 
-    if eq_send is not None:
-        # Byz support is value-independent (SPEC §6b): one count per
-        # side, minus the receiver's own stance (self never travels).
-        if no_part:
-            extra = jnp.broadcast_to(jnp.sum(eq_send.astype(jnp.int32)),
-                                     (N,))
-        else:
-            extra = jnp.stack(
-                [jnp.sum((eq_send & side_ok(0)).astype(jnp.int32)),
-                 jnp.sum((eq_send & side_ok(1)).astype(jnp.int32))
-                 ])[side]                                        # [N]
-        extra = extra - (eq_send).astype(jnp.int32)
+    if extra is not None:
+        # Rides the payload sort so the sorted-space P4 → P5 chain sees
+        # each SENDER's own per-receiver count (SPEC §7c).
         extra_sn = jnp.broadcast_to(extra[:, None], (N, S)).T
     else:
-        extra = None
         extra_sn = None
 
     def b32(x):
@@ -418,8 +413,23 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
 
     equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
     if equiv:
-        stance = (_draw(seed, rng.STREAM_EQUIV, ur, uidx,
-                        jnp.uint32(0x80000000)) & jnp.uint32(1)).astype(bool)
+        # SPEC §7c: equivocation is PER RECEIVER — byz sender i's stance
+        # toward receiver j is the dense kernel's sup(r, i, j) draw
+        # (same STREAM_EQUIV keying, so the §6 and §6b engines model
+        # the same adversary). Only the n_byzantine tail rows exist:
+        # the grid is [nb, N], never [N, N]. ``extra`` folds the §6b
+        # atomic-broadcast fate, self-exclusion and the partition
+        # filter, leaving the per-receiver support count the aggregate
+        # machinery adds to every slot.
+        nb = cfg.n_byzantine
+        bids = uidx[N - nb:]
+        supg = (_draw(seed, rng.STREAM_EQUIV, ur, bids[:, None],
+                      uidx[None, :]) & jnp.uint32(1)).astype(bool)  # [nb, N]
+        sendg = (supg & bcast[N - nb:, None]
+                 & (bids[:, None] != uidx[None, :]))
+        if not no_part:
+            sendg &= ~part_active | (side[N - nb:, None] == side[None, :])
+        eq_extra = jnp.sum(sendg.astype(jnp.int32), axis=0)        # [N]
 
     view, timer = st.view, st.timer
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
@@ -510,9 +520,16 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     pm_val = msg_val[prim]
     if equiv:
         prim_byz = byz[prim]
+        # Per-receiver fork (SPEC §7c): the byz primary's stance toward
+        # THIS receiver — sup(r, prim(j), j), the dense kernel's
+        # sup[prim, idx] — picks which of the two conflicting values it
+        # pre-prepares here.
+        sup_prim = (_draw(seed, rng.STREAM_EQUIV, ur,
+                          prim.astype(jnp.uint32), uidx)
+                    & jnp.uint32(1)).astype(bool)                  # [N]
         bval = _i32(_draw(seed, rng.STREAM_VALUE,
                           view[:, None].astype(jnp.uint32),
-                          jnp.where(stance[prim], 4, 3)[:, None]
+                          jnp.where(sup_prim, 4, 3)[:, None]
                           .astype(jnp.uint32),
                           sarange[None, :].astype(jnp.uint32)))
         prim_ok = jnp.where(prim_byz, prim_del, prim_ok)
@@ -538,20 +555,41 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
     # (the tightened `pbft-100k-bcast-switch` hlocheck ceiling).
     switch = cfg.switch_on
     if switch:
-        from ..ops.aggregate import (agg_ids, agg_round, downlink,
-                                     downlink_self, min_id_votes,
-                                     uplink_bcast, value_votes)
+        from ..ops.aggregate import (agg_ids, agg_poison, agg_round,
+                                     downlink, downlink_self, min_id_votes,
+                                     seg_widths, uplink_bcast, uplink_lies,
+                                     value_votes)
         K_agg = cfg.n_aggregators
         aggst = agg_round(cfg, seed, ur)
         sids = agg_ids(N, K_agg)
         upb = uplink_bcast(cfg, seed, aggst)
         if crash_on:
             upb &= up
-        eq_up = (byz & stance & upb) if equiv else None
+        if equiv:
+            # The switch DEDUPS per-receiver claims — a vertex holds one
+            # uplink claim per sender per round — so equivocating
+            # support through an aggregator collapses to the per-ROUND
+            # stance (its own STREAM_EQUIV key, disjoint from the
+            # sup(r, i, j) grid's receiver ids).
+            stance = (_draw(seed, rng.STREAM_EQUIV, ur, uidx,
+                            jnp.uint32(0x80000000))
+                      & jnp.uint32(1)).astype(bool)
+            eq_up = byz & stance & upb
+        else:
+            eq_up = None
+        # SPEC §9b poisoned aggregation (None / static no-op when off);
+        # P6's min-id decide gossip stays unpoisonable — the decide
+        # message carries the decider's identity (see engines/pbft.py).
+        pz4 = agg_poison(cfg, seed, ur, 0)
+        pz5 = agg_poison(cfg, seed, ur, 1)
+        wid = seg_widths(jnp.ones(N, bool), sids, K_agg) \
+            if pz4 is not None else None
+        lie, fval = uplink_lies(cfg, seed, ur, byz)
         down0 = downlink(cfg, seed, ur, aggst, 0, idx)
         dn0 = downlink_self(cfg, seed, ur, aggst, 0)
         c4 = value_votes(pp_val, honest[:, None] & pp_seen, upb, down0,
-                         dn0, sids, K_agg, eq_up=eq_up)
+                         dn0, sids, K_agg, eq_up=eq_up,
+                         lie=lie, lie_val=fval, poison=pz4, widths=wid)
         pcount = c4 + (honest[:, None] & pp_seen).astype(jnp.int32)
         prep_hit = pp_seen & (pcount >= Q)
         if crash_on:
@@ -560,7 +598,8 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         down1 = downlink(cfg, seed, ur, aggst, 1, idx)
         dn1 = downlink_self(cfg, seed, ur, aggst, 1)
         c5 = (value_votes(pp_val, honest[:, None] & prepared2, upb,
-                          down1, dn1, sids, K_agg, eq_up=eq_up)
+                          down1, dn1, sids, K_agg, eq_up=eq_up,
+                          lie=lie, lie_val=fval, poison=pz5, widths=wid)
               + (honest[:, None] & prepared2).astype(jnp.int32))
         commit_now = prepared2 & (c5 >= Q) & ~committed
         if crash_on:
@@ -571,7 +610,7 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
             _table_width(N, f, cfg.n_byzantine if equiv else 0),
             side=None if no_part else side,
             part_active=None if no_part else part_active,
-            eq_send=(byz & bcast & stance) if equiv else None,
+            extra=eq_extra if equiv else None,
             up=up if crash_on else None)
     prep_new = prep_hit & ~prepared        # telemetry (DCE'd when off)
     prep_miss = pp_seen & ~prepared & ~prep_hit
@@ -642,12 +681,31 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         return new
     cnt = lambda mk: jnp.sum(mk.astype(jnp.int32))  # noqa: E731
     cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
-    az = agg_counts(aggst) if switch else agg_counts()
+    az = agg_counts(aggst, poison_count(aggst, pz4, pz5)) if switch \
+        else agg_counts()
+    # SPEC §7c safety invariants — same reductions as the dense kernel
+    # (engines/pbft.py): forked commit quorums this round, committed-
+    # value conflicts across honest nodes. Static zeros unless a
+    # byzantine axis that can violate agreement is on.
+    unsafe = equiv or cfg.agg_poison_on or cfg.uplink_lies_on
+    if unsafe:
+        nw = commit_now & honest[:, None]
+        forked = (jnp.any(nw, axis=0)
+                  & (jnp.max(jnp.where(nw, pp_val, I32_MIN), axis=0)
+                     != jnp.min(jnp.where(nw, pp_val, I32_MAX), axis=0)))
+        cm = committed & honest[:, None]
+        conflicts = (jnp.any(cm, axis=0)
+                     & (jnp.max(jnp.where(cm, dval, I32_MIN), axis=0)
+                        != jnp.min(jnp.where(cm, dval, I32_MAX), axis=0)))
+        sz = safety_counts(forked, conflicts)
+    else:
+        sz = safety_counts()
     # view_changes clips at 0 like the dense kernel: a §6c recovery
     # resets the view, and the raw delta would cancel real advances.
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
-                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az])
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az,
+                     *sz])
     if not flight:
         return new, vec
     # Same PBFT_LATENCY semantics as the dense §6 kernel (the fault

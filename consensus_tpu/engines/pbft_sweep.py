@@ -68,18 +68,30 @@ def _padded_switch_phases(cfg: Config, seed, ur, n_real, honest,
     without equivocators. Shared by both padded rounds — ``bcast_uplink``
     selects the §6b one-broadcast-per-round uplink vs the edge model's
     per-phase uplinks. Crash (§6c) is rejected upstream by the ladder."""
-    from ..ops.aggregate import (agg_round, downlink, downlink_self,
-                                 min_id_votes, uplink_bcast, uplink_edge,
+    from ..ops.aggregate import (agg_poison, agg_round, downlink,
+                                 downlink_self, min_id_votes, seg_widths,
+                                 uplink_bcast, uplink_edge, uplink_lies,
                                  value_votes)
     N = cfg.n_nodes                      # N_pad (static)
     K = cfg.n_aggregators
     idx = jnp.arange(N, dtype=jnp.int32)
+    real = idx < n_real
     sids = jnp.minimum(idx // ((n_real + K - 1) // K), K - 1)
     aggst = agg_round(cfg, seed, ur)
     equiv = byz is not None
     if equiv:
         stance = (_draw(seed, rng.STREAM_EQUIV, ur, idx.astype(jnp.uint32),
                         jnp.uint32(0x80000000)) & jnp.uint32(1)).astype(bool)
+    # SPEC §9b poisoned aggregation on the padded lanes: forged widths
+    # count REAL segment populations only (seg_widths over the live
+    # prefix) and lies are drawn for the lane's true byzantine tail —
+    # both on absolute ids, so each rung stays byte-equal to its
+    # standalone switch run.
+    pz0 = agg_poison(cfg, seed, ur, 0)
+    pz1 = agg_poison(cfg, seed, ur, 1)
+    wid = seg_widths(real, sids, K, traced=True) if pz0 is not None \
+        else None
+    lie, fval = uplink_lies(cfg, seed, ur, real & ~honest)
 
     def up_ph(ph: int):
         if bcast_uplink:
@@ -96,6 +108,7 @@ def _padded_switch_phases(cfg: Config, seed, ur, n_real, honest,
                         n_vert=n_real)
     c4 = value_votes(pp_val, honest[:, None] & pp_seen, up0, down0, dn0,
                      sids, K, eq_up=(byz & stance & up0) if equiv else None,
+                     lie=lie, lie_val=fval, poison=pz0, widths=wid,
                      traced=True)
     pcount = c4 + (honest[:, None] & pp_seen).astype(jnp.int32)
     prepared = prepared | (pp_seen & (pcount >= Q))
@@ -105,6 +118,7 @@ def _padded_switch_phases(cfg: Config, seed, ur, n_real, honest,
     c5 = (value_votes(pp_val, honest[:, None] & prepared, up1, down1, dn1,
                       sids, K,
                       eq_up=(byz & stance & up1) if equiv else None,
+                      lie=lie, lie_val=fval, poison=pz1, widths=wid,
                       traced=True)
           + (honest[:, None] & prepared).astype(jnp.int32))
     commit_now = prepared & (c5 >= Q) & ~committed
@@ -311,8 +325,20 @@ def pbft_bcast_round_padded(cfg: Config, st: PbftState, r, n_real, f,
 
     equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
     if equiv:
-        stance = (_draw(seed, rng.STREAM_EQUIV, ur, uidx,
-                        jnp.uint32(0x80000000)) & jnp.uint32(1)).astype(bool)
+        # Per-receiver stances (SPEC §7c) with TRACED byz ids (the
+        # lane's byz rows are n_real - nb .. n_real): the full [N, N]
+        # sup draw masked to byz senders — the same absolute (r, i, j)
+        # keys as the dedicated engine's [nb, N] grid, so each rung is
+        # byte-equal to its standalone run. Materialized only when
+        # equivocators exist; the byz-free contract-pinned ladder
+        # program never pays it.
+        supg = (_draw(seed, rng.STREAM_EQUIV, ur, uidx[:, None],
+                      uidx[None, :]) & jnp.uint32(1)).astype(bool)
+        sendg = (supg & (byz & bcast)[:, None]
+                 & (idx[:, None] != idx[None, :]))
+        if not no_part:
+            sendg &= ~part_active | (side[:, None] == side[None, :])
+        eq_extra = jnp.sum(sendg.astype(jnp.int32), axis=0)      # [N]
 
     view, timer = st.view, st.timer
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
@@ -376,9 +402,14 @@ def pbft_bcast_round_padded(cfg: Config, st: PbftState, r, n_real, f,
     pm_val = msg_val[prim]
     if equiv:
         prim_byz = byz[prim]
+        # Per-receiver fork — sup(r, prim(j), j), the same key as the
+        # dedicated engine and the dense kernel's sup[prim, idx].
+        sup_prim = (_draw(seed, rng.STREAM_EQUIV, ur,
+                          prim.astype(jnp.uint32), uidx)
+                    & jnp.uint32(1)).astype(bool)
         bval = _i32(_draw(seed, rng.STREAM_VALUE,
                           view[:, None].astype(jnp.uint32),
-                          jnp.where(stance[prim], 4, 3)[:, None]
+                          jnp.where(sup_prim, 4, 3)[:, None]
                           .astype(jnp.uint32),
                           sarange[None, :].astype(jnp.uint32)))
         prim_ok = jnp.where(prim_byz, prim_del & real, prim_ok)
@@ -408,7 +439,7 @@ def pbft_bcast_round_padded(cfg: Config, st: PbftState, r, n_real, f,
             pp_val, pp_seen, prepared, committed, honest, bcast, Q, m_cap,
             side=None if no_part else side,
             part_active=None if no_part else part_active,
-            eq_send=(byz & bcast & stance) if equiv else None)
+            extra=eq_extra if equiv else None)
         dval = jnp.where(commit_now, pp_val, dval)
         committed = committed | commit_now
 

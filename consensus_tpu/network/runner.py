@@ -1102,7 +1102,9 @@ def run_knob_batch(cfg: Config, eng: EngineDef, seeds, kmat, *,
              "suppress_cutoff": cfg.suppress_on,
              "partition_cutoff": not cfg.no_partition,
              "attack_cutoff": cfg.attack != "none",
-             "attack_target": cfg.attack != "none"}
+             "attack_target": cfg.attack != "none",
+             "agg_poison_cutoff": cfg.agg_poison_on,
+             "byz_uplink_cutoff": cfg.uplink_lies_on}
     for i, name in enumerate(knobslib.KNOB_COLUMNS):
         if not gates.get(name, True) \
                 and (kmat[:, i] != np.uint32(getattr(cfg, name))).any():

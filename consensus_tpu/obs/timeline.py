@@ -54,7 +54,8 @@ COMMIT_COUNTERS = {
 # are what an availability attack looks like from inside the protocol).
 FAULT_COUNTERS = ("crashes", "nodes_down", "missed_slots",
                   "suppressed_slots", "attack_rounds", "agg_down_rounds",
-                  "stale_serves", "leader_elections", "view_changes")
+                  "stale_serves", "poisoned_serves", "forked_qc",
+                  "leader_elections", "view_changes")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -266,7 +267,7 @@ def lane_fitness(tl: Timeline) -> list[dict[str, Any]]:
     for b in range(tl.n_sweeps):
         rec = d["recovery_rounds"][b]
         stalls = d["stall_windows"]["per_sweep"][b]
-        out.append({
+        m = {
             "availability": d["availability"]["per_sweep"][b],
             "stall_windows": stalls,
             "stall_ratio": round(stalls / tl.n_windows, 6),
@@ -274,7 +275,16 @@ def lane_fitness(tl: Timeline) -> list[dict[str, Any]]:
             "recovery_rounds": rec,
             "never_recovered": rec == -1,
             "commit_rate": round(float(commits[b].sum()) / tl.n_rounds, 6),
-        })
+        }
+        # SPEC §7c safety-invariant totals, only when the engine's
+        # recorder carries them (the BFT vote engines): a nonzero
+        # safety_violations total is a SAFETY finding — categorically
+        # worse than any liveness dip, and scored as such by the
+        # adversary search (tools/advsearch.severity_of).
+        for name in ("forked_qc", "conflict_commits", "safety_violations"):
+            if name in tl.windows:
+                m[name] = int(tl.windows[name][b].sum())
+        out.append(m)
     return out
 
 

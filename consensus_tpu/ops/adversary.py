@@ -151,6 +151,30 @@ def crash_counts(crashed=None, rec=None, down=None):
             jnp.sum(down.astype(jnp.int32)))
 
 
+# SPEC §7c vote-certificate safety-invariant tail shared by the BFT
+# engines' counter vectors (pbft, pbft_bcast, the padded f-ladder,
+# hotstuff): the agreement violations the per-receiver equivocation and
+# poisoned-combine adversaries can actually cause, reduced on device
+# from the round's own tallies. All three are zeros when the knobs are
+# off — safety counters never fire under crash/drop/partition alone,
+# which is exactly the invariant scenarios assert on.
+SAFETY_TELEMETRY = ("forked_qc",          # conflicting quorums certified
+                    "conflict_commits",   # node-slots committed in conflict
+                    "safety_violations")  # per-round agreement-violation flag
+
+
+def safety_counts(forked=None, conflicts=None):
+    """The :data:`SAFETY_TELEMETRY` tail of an engine's counter vector —
+    call with no args for the knobs-off zeros. ``forked``/``conflicts``
+    are masks or counts; ``safety_violations`` is derived (0/1 per
+    round) so the flag can never disagree with the conflict count."""
+    if forked is None:
+        return (jnp.int32(0),) * 3
+    nf = jnp.sum(jnp.asarray(forked, jnp.int32))
+    nc = jnp.sum(jnp.asarray(conflicts, jnp.int32))
+    return (nf, nc, (nc > 0).astype(jnp.int32))
+
+
 def delivery_edges(seed, r, src, dst, drop_cut: int, part_cut: int,
                    max_delay: int = 0):
     """SPEC §2 delivery evaluated on explicit (src, dst) edge id arrays.

@@ -55,8 +55,9 @@ I32_MIN = jnp.iinfo(jnp.int32).min
 
 # SPEC §9 telemetry tail shared by every switch-capable engine's counter
 # vector (zeros when net_model="flat", like the §6c CRASH_TELEMETRY).
-AGG_TELEMETRY = ("agg_down_rounds",  # Σ per-round failed aggregators
-                 "stale_serves")     # Σ per-round stale-serving (alive) aggs
+AGG_TELEMETRY = ("agg_down_rounds",   # Σ per-round failed aggregators
+                 "stale_serves",      # Σ per-round stale-serving (alive) aggs
+                 "poisoned_serves")   # Σ per-round forged combines (§9b)
 
 # Phase table (documented in SPEC §9; phases are per-protocol, so ids
 # may repeat across protocols — one run never mixes them):
@@ -115,12 +116,89 @@ def agg_round(cfg, seed, r) -> AggRound:
     return AggRound(alive, q, down_count, stale_count)
 
 
-def agg_counts(agg: AggRound | None = None):
+def agg_counts(agg: AggRound | None = None, poisoned=None):
     """The :data:`AGG_TELEMETRY` tail of an engine's counter vector —
-    call with no args for the flat-model zeros."""
+    call with no args for the flat-model zeros. ``poisoned`` is the
+    engine's :func:`poison_count` accumulation across the round's
+    phases (None when the §9b knob is off)."""
     if agg is None:
-        return (jnp.int32(0),) * 2
-    return (agg.down_count, agg.stale_count)
+        return (jnp.int32(0),) * 3
+    pz = jnp.int32(0) if poisoned is None else poisoned
+    return (agg.down_count, agg.stale_count, pz)
+
+
+# --- SPEC §9b poisoned combines --------------------------------------------
+
+def agg_poison(cfg, seed, r, phase: int):
+    """SPEC §9b: [K] mask of aggregators serving FORGED combines this
+    (round, phase) — or None when the knob is off (static no-draw, so
+    zero-rate configs compile the §9 program unchanged).
+
+    The LAST ``agg_byz`` aggregator ids are byzantine (mirrors the
+    node-side convention: byzantine ids are the tail of the range);
+    each fires independently per (round, phase-qualified vertex) via
+    STREAM_POISON c0 = 0 with c1 = ph*K + a — the same phase
+    qualification as the vertex's edge draws, so the two pbft vote
+    phases equivocate independently. Scalar twin: cpp/oracle.cpp
+    ``AggNet::poisoned``."""
+    if not cfg.agg_poison_on:
+        return None
+    K = cfg.n_aggregators
+    ua = jnp.arange(K, dtype=jnp.uint32)
+    byz_a = jnp.arange(K, dtype=jnp.int32) >= jnp.int32(K - cfg.agg_byz)
+    fire = draw(seed, rng.STREAM_POISON, jnp.asarray(r, jnp.uint32), 0,
+                jnp.uint32(phase * K) + ua) < _lt(cfg.agg_poison_cutoff)
+    return byz_a & fire
+
+
+def uplink_lies(cfg, seed, r, byz):
+    """SPEC §9b byzantine-uplink lies: ``(lie, fval)`` — [N] mask of
+    byzantine senders forging their uplink claim this round, and the
+    [N] i32 forged value each serves — or ``(None, None)`` when the
+    knob is off. STREAM_POISON c0 = 1 is the activation draw (per
+    (round, node)); c0 = 2 is the forged value (bitcast to i32, the
+    same 32-bit payload discipline as STREAM_VALUE blocks). ``byz`` is
+    the engine's byzantine-SENDER mask (``real & ~honest`` in the
+    padded f-ladder — padding never lies; both draws key on absolute
+    node ids, so the ladder's lies are byte-equal to each rung's
+    standalone run). The lie is one claim per node per round — every
+    phase and slot sees the same forged (vote, value), which is what
+    makes a single liar able to break a whole segment's
+    value-uniformity (vote suppression) or, in an all-byzantine
+    segment, serve a forged value outright."""
+    if not cfg.uplink_lies_on:
+        return None, None
+    from .adversary import bitcast_i32
+    N = byz.shape[0]
+    ui = jnp.arange(N, dtype=jnp.uint32)
+    ur = jnp.asarray(r, jnp.uint32)
+    lie = byz & (draw(seed, rng.STREAM_POISON, ur, 1, ui)
+                 < _lt(cfg.byz_uplink_cutoff))
+    fval = bitcast_i32(draw(seed, rng.STREAM_POISON, ur, 2, ui))
+    return lie, fval
+
+
+def seg_widths(valid, seg_ids, K: int, traced: bool = False):
+    """[K] i32 segment populations — the forged full-support count a
+    poisoned aggregator serves (§9b claims its ENTIRE segment voted for
+    the receiver's value). ``valid`` masks real node ids (all-ones for
+    the static engines; the lane's live prefix in the padded f-ladder,
+    so padding ids never inflate a forged claim)."""
+    return seg_sum(valid.astype(jnp.int32), seg_ids, K, traced)
+
+
+def poison_count(agg: AggRound, *masks):
+    """Telemetry: Σ poisoned-serving aggregators across the round's
+    phases (alive ones only — a failed aggregator serves nothing, so a
+    dead-and-poisoned draw is not a serve). ``masks`` are the per-phase
+    :func:`agg_poison` results; None entries (phase knob off) skip."""
+    tot = jnp.int32(0)
+    for m in masks:
+        if m is None:
+            continue
+        live = m if agg.alive is None else (m & agg.alive)
+        tot = tot + jnp.sum(live.astype(jnp.int32))
+    return tot
 
 
 def take_seg(table, seg_ids, K: int):
@@ -295,7 +373,8 @@ def downlink_self(cfg, seed, r, agg: AggRound, phase: int, *, seg_ids=None,
 # --- pbft value-matched tallies --------------------------------------------
 
 def value_votes(vals, contrib, up, down, down_own, seg_ids, K: int, *,
-                eq_up=None, traced: bool = False):
+                eq_up=None, lie=None, lie_val=None, poison=None,
+                widths=None, traced: bool = False):
     """SPEC §9 switch tally for value-matched votes (pbft P4/P5): each
     aggregator combines its segment's live contributions into
     ``(count, vmax, vmin)`` — it SERVES ``(count, value)`` iff the
@@ -312,13 +391,43 @@ def value_votes(vals, contrib, up, down, down_own, seg_ids, K: int, *,
     no value to pin a byz claim to, so an all-byz segment serves
     nothing). Returns [N, S] i32 switch-delivered counts with the
     receiver's own returned copy subtracted — the caller adds the local
-    self vote."""
+    self vote.
+
+    SPEC §9b adversary axes (both compile away when off):
+
+    ``lie``/``lie_val`` ([N] bool / [N] i32, :func:`uplink_lies`): a
+    lying sender's forged (vote, value) claim joins the combine —
+    its count rides the segment total and its value folds into the
+    uniformity check, so a single liar in a segment with honest
+    contributors breaks uniformity and suppresses the WHOLE segment,
+    while an all-liar segment serves the forged value outright. A
+    forged claim is not a local vote, so it is never self-subtracted.
+
+    ``poison``/``widths`` ([K] bool / [K] i32, :func:`agg_poison` /
+    :func:`seg_widths`): a poisoned (byzantine) aggregator overrides
+    its serve entirely — it claims its FULL segment population voted
+    for whatever value the receiver itself holds (the forged combine a
+    receiver cannot cross-check without the raw votes, PAPERS.md
+    1605.05619's trust gap). Failed aggregators stay silent (``down``
+    already folds ``alive``). The receiver's own forged slot is
+    discounted iff it contributes locally (the caller adds that self
+    vote), keeping the total ≤ the segment population."""
     live = contrib & up[:, None]                                   # [N, S]
     cnt = seg_sum(live.astype(jnp.int32), seg_ids, K, traced)      # [K, S]
     vmax = seg_max(jnp.where(live, vals, I32_MIN), seg_ids, K,
                    I32_MIN, traced)
     vmin = seg_min(jnp.where(live, vals, I32_MAX), seg_ids, K,
                    I32_MAX, traced)
+    if lie is not None:
+        liar = lie & up                                            # [N]
+        cnt = cnt + seg_sum(liar.astype(jnp.int32), seg_ids, K,
+                            traced)[:, None]
+        lmax = seg_max(jnp.where(liar, lie_val, I32_MIN), seg_ids, K,
+                       I32_MIN, traced)                            # [K]
+        lmin = seg_min(jnp.where(liar, lie_val, I32_MAX), seg_ids, K,
+                       I32_MAX, traced)
+        vmax = jnp.maximum(vmax, lmax[:, None])
+        vmin = jnp.minimum(vmin, lmin[:, None])
     serve = (cnt > 0) & (vmax == vmin)                             # [K, S]
     total = cnt
     if eq_up is not None:
@@ -334,14 +443,29 @@ def value_votes(vals, contrib, up, down, down_own, seg_ids, K: int, *,
     for a in range(K):
         hit = (down[a][:, None] & serve[a][None, :]
                & (vmax[a][None, :] == vals))
-        c = c + jnp.where(hit, total[a][None, :], 0)
+        term = jnp.where(hit, total[a][None, :], 0)
+        if poison is not None:
+            term = jnp.where(poison[a] & down[a][:, None], widths[a],
+                             term)
+        c = c + term
     serve_own = take_seg(serve, seg_ids, K)                        # [N, S]
     val_own = take_seg(vmax, seg_ids, K)
     hit_own = serve_own & (val_own == vals) & down_own[:, None]
-    c = c - (live & hit_own).astype(jnp.int32)
+    sub = (live & hit_own).astype(jnp.int32)
+    eq_sub = None
     if eq_up is not None:
-        c = c - ((eq_up & down_own)[:, None] & serve_own
-                 & (val_own == vals)).astype(jnp.int32)
+        eq_sub = ((eq_up & down_own)[:, None] & serve_own
+                  & (val_own == vals)).astype(jnp.int32)
+    if poison is not None:
+        pz_own = (take_seg(poison, seg_ids, K) & down_own)[:, None]
+        sub = jnp.where(pz_own, contrib.astype(jnp.int32), sub)
+        if eq_sub is not None:
+            # The forged width already counts every segment id once;
+            # an equivocating claim never rode the poisoned serve.
+            eq_sub = jnp.where(pz_own, 0, eq_sub)
+    c = c - sub
+    if eq_sub is not None:
+        c = c - eq_sub
     return c
 
 

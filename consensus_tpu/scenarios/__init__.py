@@ -48,6 +48,15 @@ class TimelineBounds:
     * ``max_lib_ratio`` — DPoS only: mean (lib+1) / mean chain head
       must stay at or below this — the LIB-stall assertion (SPEC §7
       irreversibility trails the head under per-producer faults).
+    * ``min_counters`` / ``max_counters`` — per-counter bounds on the
+      run's TOTAL of a flight-recorder counter across sweeps and
+      windows. This is how safety scenarios assert the SPEC §7c
+      invariant telemetry: ``min_counters={"forked_qc": 1}`` demands
+      the attack actually forged a certificate, and
+      ``max_counters={"safety_violations": 0}`` is the negative
+      assertion that an availability-only attack never crossed into
+      agreement violation. A counter the engine does not record totals
+      0 (so a min bound on it fails loudly).
     """
     require_fault_onset: bool = True
     max_availability: float | None = None
@@ -55,6 +64,8 @@ class TimelineBounds:
     min_stall_windows: int | None = None
     max_recovery_rounds: int | None = None
     max_lib_ratio: float | None = None
+    min_counters: Mapping[str, int] | None = None
+    max_counters: Mapping[str, int] | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -345,6 +356,16 @@ def evaluate(scenario: Scenario, result) -> dict:
         ratio = float((lib + 1).mean() / max(1.0, float(head.mean())))
         _check(checks, "lib_stall", ratio <= b.max_lib_ratio,
                round(ratio, 6), b.max_lib_ratio)
+
+    def counter_total(name: str) -> int:
+        w = tl.windows.get(name)
+        return 0 if w is None else int(w.sum())
+    for name, lo in sorted((b.min_counters or {}).items()):
+        tot = counter_total(name)
+        _check(checks, f"min_{name}", tot >= int(lo), tot, int(lo))
+    for name, hi in sorted((b.max_counters or {}).items()):
+        tot = counter_total(name)
+        _check(checks, f"max_{name}", tot <= int(hi), tot, int(hi))
 
     return {"name": scenario.name,
             "passed": all(c["ok"] for c in checks.values()),

@@ -54,6 +54,9 @@ struct Args {
   uint32_t n_aggregators = 0;
   double agg_fail_rate = 0.0, agg_stale_rate = 0.0;
   uint32_t agg_max_stale = 1;
+  // SPEC §9b poisoned aggregation (pbft/hotstuff switch models only).
+  uint32_t agg_byz = 0;
+  double agg_poison_rate = 0.0, byz_uplink_rate = 0.0;
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
   std::string byz_mode = "silent";
   std::string fault_model = "edge";  // "edge" (SPEC §2) | "bcast" (§6b, pbft)
@@ -104,6 +107,7 @@ uint32_t prob_threshold_u32(double p) {
       "  [--max-delay-rounds D]    (SPEC A.2 bounded delay, D <= 16)\n"
       "  [--net-model flat|switch] [--n-aggregators K]   (SPEC 9)\n"
       "  [--agg-fail-rate P] [--agg-stale-rate P] [--agg-max-stale D]\n"
+      "  [--agg-byz K] [--agg-poison-rate P] [--byz-uplink-rate P] (SPEC 9b)\n"
       "  [--f F] [--view-timeout T] [--n-byzantine K]\n"
       "  [--byz-mode silent|equivocate] [--fault-model edge|bcast]\n"
       "  [--oracle-delivery auto|dense|edge]  (cpu engine; digests equal)\n"
@@ -152,6 +156,9 @@ Args parse(int argc, char** argv) {
     else if (k == "--agg-fail-rate") a.agg_fail_rate = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--agg-stale-rate") a.agg_stale_rate = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--agg-max-stale") a.agg_max_stale = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--agg-byz") a.agg_byz = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
+    else if (k == "--agg-poison-rate") a.agg_poison_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--byz-uplink-rate") a.byz_uplink_rate = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--f") a.f = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--view-timeout") a.view_timeout = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--n-byzantine") a.n_byzantine = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
@@ -170,14 +177,6 @@ Args parse(int argc, char** argv) {
   }
   if ((a.protocol == "pbft" || a.protocol == "hotstuff") && !a.nodes_given)
     a.nodes = 3 * a.f + 1;
-  if (a.protocol == "hotstuff" && a.byz_mode == "equivocate") {
-    std::fprintf(stderr,
-                 "--byz-mode equivocate: hotstuff models only the silent "
-                 "byzantine minority (SPEC 7b: votes are threshold counts "
-                 "at the leader — no per-value tally to poison); the mode "
-                 "would silently behave as silent\n");
-    std::exit(2);
-  }
   if (a.byz_mode != "silent" && a.byz_mode != "equivocate") {
     std::fprintf(stderr, "unknown --byz-mode %s\n", a.byz_mode.c_str());
     std::exit(2);
@@ -232,11 +231,43 @@ Args parse(int argc, char** argv) {
                    "--nodes (SPEC 9)\n");
       std::exit(2);
     }
+    if ((a.agg_byz != 0 || a.agg_poison_rate != 0.0 ||
+         a.byz_uplink_rate != 0.0) &&
+        a.protocol != "pbft" && a.protocol != "hotstuff") {
+      std::fprintf(stderr,
+                   "--agg-byz/--agg-poison-rate/--byz-uplink-rate poison "
+                   "value-carrying combines (SPEC 9b) — a BFT-only model; "
+                   "%s would silently ignore them\n", a.protocol.c_str());
+      std::exit(2);
+    }
+    if (a.agg_byz > a.n_aggregators) {
+      std::fprintf(stderr,
+                   "--agg-byz must be <= --n-aggregators (SPEC 9b: the "
+                   "byzantine aggregators are the last agg-byz vertex "
+                   "ids)\n");
+      std::exit(2);
+    }
+    if (a.agg_poison_rate > 0 && a.agg_byz == 0) {
+      std::fprintf(stderr,
+                   "--agg-poison-rate > 0 requires --agg-byz > 0 "
+                   "(SPEC 9b)\n");
+      std::exit(2);
+    }
+    if (a.byz_uplink_rate > 0 && a.n_byzantine == 0) {
+      std::fprintf(stderr,
+                   "--byz-uplink-rate > 0 requires --n-byzantine > 0 "
+                   "(SPEC 9b: only byzantine replicas lie to their switch "
+                   "uplink)\n");
+      std::exit(2);
+    }
   } else if (a.n_aggregators != 0 || a.agg_fail_rate != 0.0 ||
-             a.agg_stale_rate != 0.0 || a.agg_max_stale != 1) {
+             a.agg_stale_rate != 0.0 || a.agg_max_stale != 1 ||
+             a.agg_byz != 0 || a.agg_poison_rate != 0.0 ||
+             a.byz_uplink_rate != 0.0) {
     std::fprintf(stderr,
                  "--n-aggregators/--agg-fail-rate/--agg-stale-rate/"
-                 "--agg-max-stale require --net-model switch (SPEC 9) — "
+                 "--agg-max-stale/--agg-byz/--agg-poison-rate/"
+                 "--byz-uplink-rate require --net-model switch (SPEC 9) — "
                  "they would be silently ignored\n");
     std::exit(2);
   }
@@ -348,6 +379,9 @@ int run_cpu(const Args& a) {
   cfg.agg_fail_cut = prob_threshold_u32(a.agg_fail_rate);
   cfg.agg_stale_cut = prob_threshold_u32(a.agg_stale_rate);
   cfg.agg_max_stale = a.agg_max_stale;
+  cfg.agg_byz = a.agg_byz;
+  cfg.agg_poison_cut = prob_threshold_u32(a.agg_poison_rate);
+  cfg.byz_uplink_cut = prob_threshold_u32(a.byz_uplink_rate);
   cfg.f = a.f;
   cfg.view_timeout = a.view_timeout;
   cfg.n_byzantine = a.n_byzantine;

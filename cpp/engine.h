@@ -52,6 +52,12 @@ struct SimConfig {
   // fault axes. Not a dpos model (the producer row doesn't vote).
   uint32_t net_switch = 0, n_aggregators = 0;
   uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
+  // SPEC §9b poisoned aggregation (pbft/hotstuff switch models only):
+  // the last agg_byz aggregator vertices serve forged full-segment
+  // tallies with probability agg_poison_cut per (round, aggregator),
+  // and each byzantine node lies to its switch uplink with probability
+  // byz_uplink_cut per round (STREAM_POISON subdraws 0/1/2).
+  uint32_t agg_byz = 0, agg_poison_cut = 0, byz_uplink_cut = 0;
   // SPEC §A.4 correlated DPoS producer suppression: one draw per
   // (round / suppress_window, producer) — a suppressed producer misses
   // every slot inside the window (dpos only).

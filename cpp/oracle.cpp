@@ -144,12 +144,45 @@ struct AggNet {
   bool on = false;
   uint32_t N = 0, K = 1, B = 1;
   uint32_t drop_cut = 0, part_cut = 0, max_delay = 0;
+  // SPEC §9b poisoned-combine knobs — set once by the owning Sim
+  // before the run (begin_round never touches them; every §9b draw
+  // keys on the live round r, so there is no per-round state).
+  uint32_t agg_byz = 0, poison_cut = 0, uplink_cut = 0;
   uint64_t seed = 0;
   uint32_t r = 0;
   std::vector<uint8_t> alive;  // [K]
   std::vector<uint32_t> q;     // [K] effective uplink round
 
   uint32_t agg_of(uint32_t i) const { return i / B; }
+
+  // §9b forged-combine activation: the LAST agg_byz aggregator ids are
+  // byzantine (the node-side tail convention); each fires per (round,
+  // phase-qualified vertex) via STREAM_POISON c0 = 0 — the same phase
+  // qualification as the vertex's edge draws, so the two pbft vote
+  // phases equivocate independently. Liveness is NOT checked here:
+  // down() already folds alive, and a dead aggregator serves nothing.
+  bool poisoned(uint32_t ph, uint32_t a) const {
+    if (!poison_cut || a + agg_byz < K) return false;
+    return random_u32(seed, STREAM_POISON, r, 0, ph * K + a) < poison_cut;
+  }
+  // §9b uplink-lie activation (c0 = 1, one claim per (round, node) —
+  // shared by every phase and slot) and the forged value it serves
+  // (c0 = 2, the same 32-bit payload discipline as STREAM_VALUE).
+  // The byzantine-sender mask is the caller's guard.
+  bool lies(uint32_t i) const {
+    return uplink_cut &&
+           random_u32(seed, STREAM_POISON, r, 1, i) < uplink_cut;
+  }
+  uint32_t lie_val(uint32_t i) const {
+    return random_u32(seed, STREAM_POISON, r, 2, i);
+  }
+  // Full segment population — the forged count a poisoned aggregator
+  // serves (§9b: it claims its ENTIRE segment voted the receiver's
+  // value). The last segment may be a remainder.
+  uint32_t width(uint32_t a) const {
+    const uint32_t lo = a * B;
+    return lo >= N ? 0 : std::min(B, N - lo);
+  }
 
   void begin_round(uint64_t seed_, uint32_t n, uint32_t k, uint32_t r_,
                    uint32_t drop_cut_, uint32_t part_cut_,
@@ -902,16 +935,15 @@ struct PbftSim {
   };
   BcastNet bnet;
 
-  // Byz i's per-ROUND stance (SPEC §6b item 3).
+  // Byz i's per-ROUND stance (SPEC §9: the switch dedups per-receiver
+  // claims, so ONLY the aggregated round uses it; both flat fault
+  // models equivocate per receiver via sup(r, i, j) — SPEC §7c).
   bool stance(uint32_t r, uint32_t i) const {
     return random_u32(seed, STREAM_EQUIV, r, i, 0x80000000u) & 1u;
   }
-  // Fault-model-dispatched delivery + equivocation stance.
+  // Fault-model-dispatched delivery.
   bool del(uint32_t /*r*/, uint32_t i, uint32_t j) const {
     return fault_bcast ? bnet.delivered(i, j) : net.delivered(i, j);
-  }
-  bool eq_sup(uint32_t r, uint32_t i, uint32_t j) const {
-    return fault_bcast ? stance(r, i) : sup(r, i, j);
   }
 
   void run() {
@@ -984,7 +1016,7 @@ struct PbftSim {
         uint32_t v;
         if (prim_byz) {
           v = random_u32(seed, STREAM_VALUE, view[j],
-                         eq_sup(r, prim, j) ? 4 : 3, s);
+                         sup(r, prim, j) ? 4 : 3, s);
         } else {
           if (!s_ppb[at(prim, s)]) continue;
           v = s_msgval[at(prim, s)];
@@ -1055,8 +1087,8 @@ struct PbftSim {
               (i == j || del(r, i, j)))
             ++cnt;
           else if (equiv && !honest(i) && i != j && del(r, i, j) &&
-                   eq_sup(r, i, j))
-            ++cnt;  // byz i claims j's exact value iff its stance coin
+                   sup(r, i, j))
+            ++cnt;  // byz i claims j's exact value iff sup(r, i, j)
         }
         if (cnt >= Q) prepared[at(j, s)] = 1;
       }
@@ -1074,7 +1106,7 @@ struct PbftSim {
               (i == j || del(r, i, j)))
             ++cnt;
           else if (equiv && !honest(i) && i != j && del(r, i, j) &&
-                   eq_sup(r, i, j))
+                   sup(r, i, j))
             ++cnt;
         }
         if (cnt >= Q) {
@@ -1174,18 +1206,21 @@ struct PbftSim {
     // P3 pre-prepare (shared).
     phase_preprepare(r);
 
-    // Per-round equivocation support: one count per side minus the
-    // receiver's own stance (self never travels) — value-independent
-    // under §6b, so it is computed once per round, not per slot.
-    uint32_t eqb[2] = {0, 0};
-    std::vector<uint8_t> eq_send;
+    // Per-RECEIVER equivocation support (SPEC §7c): byz i's stance
+    // toward receiver j is the dense kernel's sup(r, i, j) draw, with
+    // the §6b atomic-broadcast fate, self-exclusion and the partition
+    // filter folded — still value-independent, so one count per
+    // receiver serves every slot. O(n_byz · N) once per round.
+    std::vector<uint32_t> eq_cnt;
     if (equiv && n_byz > 0) {
-      eq_send.assign(N, 0);
-      for (uint32_t i = 0; i < N; ++i)
-        if (!honest(i) && bnet.bcast[i] && stance(r, i)) {
-          eq_send[i] = 1;
-          ++eqb[side_of(i)];
-        }
+      eq_cnt.assign(N, 0);
+      for (uint32_t i = N - n_byz; i < N; ++i) {
+        if (!bnet.bcast[i]) continue;
+        for (uint32_t j = 0; j < N; ++j)
+          if (i != j && (!part || bnet.side[i] == bnet.side[j]) &&
+              sup(r, i, j))
+            ++eq_cnt[j];
+      }
     }
 
     // P4 + P5 per slot in value-sorted runs: every node rides one sort
@@ -1218,7 +1253,7 @@ struct PbftSim {
       const auto count_for = [&](uint32_t j) -> uint32_t {
         uint32_t c = cnt[size_t(run_of[j]) * n_sides + side_of(j)];
         if (honest(j) && !bnet.bcast[j]) ++c;  // self vote never travels
-        if (equiv && n_byz > 0) c += eqb[side_of(j)] - eq_send[j];
+        if (equiv && n_byz > 0) c += eq_cnt[j];
         return c;
       };
       // P4 prepare tally (value-matched, incl. self). A down receiver
@@ -1348,6 +1383,19 @@ struct PbftSim {
       for (uint32_t i = 0; i < N; ++i)
         if (eq_send[i] && up_ph[ph][i]) ++eqc[ph][agg.agg_of(i)];
     }
+    // §9b uplink lies: one forged (vote, value) claim per (round, byz
+    // node), shared by both vote phases and every slot (the engines'
+    // ops/aggregate.uplink_lies). The claim joins its segment's
+    // combine — count rides the total, forged value folds into the
+    // uniformity check — so a single liar among honest contributors
+    // suppresses its whole segment, while an all-liar segment serves
+    // the forged value outright. up_ph already folds §6c crash, so a
+    // crashed liar claims nothing.
+    std::vector<uint8_t> lie_act(N, 0);
+    std::vector<uint32_t> lie_v(N, 0);
+    if (agg.uplink_cut && n_byz > 0)
+      for (uint32_t i = N - n_byz; i < N; ++i)
+        if (agg.lies(i)) { lie_act[i] = 1; lie_v[i] = agg.lie_val(i); }
 
     const std::vector<uint8_t> s_seen = pp_seen;
     const std::vector<uint32_t> s_val = pp_val;
@@ -1359,19 +1407,17 @@ struct PbftSim {
     const auto aggregate = [&](uint32_t ph, uint32_t s,
                                const std::vector<uint8_t>& relevant) {
       std::fill(cnt.begin(), cnt.end(), 0);
-      bool first;
-      for (uint32_t a = 0; a < K; ++a) srv[a] = 0;
-      for (uint32_t a = 0; a < K; ++a) vmx[a] = 0;
       std::vector<uint32_t> vmn(K, 0);
-      first = true;
-      for (uint32_t i = 0; i < N; ++i) {
-        if (!honest(i) || !relevant[at(i, s)] || !up_ph[ph][i]) continue;
-        const uint32_t a = agg.agg_of(i), v = s_val[at(i, s)];
+      const auto fold = [&](uint32_t a, uint32_t v) {
         if (cnt[a] == 0) { vmx[a] = v; vmn[a] = v; }
         else { vmx[a] = std::max(vmx[a], v); vmn[a] = std::min(vmn[a], v); }
         ++cnt[a];
-      }
-      (void)first;
+      };
+      for (uint32_t i = 0; i < N; ++i)
+        if (honest(i) && relevant[at(i, s)] && up_ph[ph][i])
+          fold(agg.agg_of(i), s_val[at(i, s)]);
+      for (uint32_t i = 0; i < N; ++i)
+        if (lie_act[i] && up_ph[ph][i]) fold(agg.agg_of(i), lie_v[i]);
       for (uint32_t a = 0; a < K; ++a)
         srv[a] = cnt[a] > 0 && vmx[a] == vmn[a];
     };
@@ -1382,14 +1428,27 @@ struct PbftSim {
       const uint32_t v = s_val[at(j, s)];
       uint32_t c = 0;
       for (uint32_t a = 0; a < K; ++a) {
-        if (!srv[a] || vmx[a] != v) continue;
         if (!agg.down(ph, a, j)) continue;
+        // §9b: a poisoned delivered aggregator overrides its serve —
+        // forged full-segment population, matched to the receiver's
+        // own value by construction (no uniformity check, no eq
+        // rider — the forged combine replaces the real one entirely).
+        if (agg.poisoned(ph, a)) { c += agg.width(a); continue; }
+        if (!srv[a] || vmx[a] != v) continue;
         c += cnt[a] + eqc[ph][a];
       }
       const uint32_t aj = agg.agg_of(j);
-      if (srv[aj] && vmx[aj] == v && agg.down(ph, aj, j)) {
-        if (own_contrib && up_ph[ph][j]) --c;         // own vote returned
-        if (eq_send[j] && up_ph[ph][j]) --c;          // own stance returned
+      if (agg.down(ph, aj, j)) {
+        if (agg.poisoned(ph, aj)) {
+          // The forged width already counts every segment id once —
+          // discount the receiver's own slot iff it contributes
+          // locally (the caller adds that self vote); an equivocating
+          // stance never rode the poisoned serve.
+          if (own_contrib) --c;
+        } else if (srv[aj] && vmx[aj] == v) {
+          if (own_contrib && up_ph[ph][j]) --c;     // own vote returned
+          if (eq_send[j] && up_ph[ph][j]) --c;      // own stance returned
+        }
       }
       return c;
     };
@@ -1738,6 +1797,7 @@ struct DposSim {
 struct HotstuffSim {
   uint64_t seed;
   uint32_t N, R, S, f, view_timeout, n_byz;
+  uint32_t equiv = 0;  // byz_mode == "equivocate" (SPEC §7c fork model)
   uint32_t drop_cut, part_cut, churn_cut;
   // SPEC §6c / §A.2 adversary knobs (0 = off).
   uint32_t crash_cut = 0, recover_cut = 0, max_crashed = 0, max_delay = 0;
@@ -1747,12 +1807,26 @@ struct HotstuffSim {
   uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
   AggNet agg;
 
+  // SPEC §7c fork-certificate table depth — mirrors
+  // engines/hotstuff.py FORK_TABLE (at most this many forked QCs are
+  // value-tracked; later forks still alter nothing durable).
+  static constexpr uint32_t FORK_TABLE = 8;
+
   // Global pacemaker + QC-chain state (the network's shared state —
-  // forks are unreachable: a QC certifies one block per height and the
-  // next proposal extends the newest QC).
+  // without an equivocating leader forks are unreachable: a QC
+  // certifies one block per height and the next proposal extends the
+  // newest QC; SPEC §7c re-admits them via per-receiver proposal
+  // variants and double-voting byzantine replicas).
   uint32_t gview = 0, gtimer = 0, gcommit = 0;
   int32_t b1_v = -1, b1_h = -1, b2_v = -1, b2_h = -1, b3_v = -1, b3_h = -1;
   std::vector<int32_t> chain_view;  // [S]; -1 = height never certified
+  std::vector<int32_t> chain_vid;   // [S] §7c canonical value-id (0/1)
+  // §7c fork certificates: entry k = a forked QC's (view, height);
+  // fvec bit k marks the honest receivers shown the NON-canonical
+  // variant at that fork — their decided value diverges there.
+  std::vector<uint32_t> fvec;       // [N]
+  int32_t ftab_v[FORK_TABLE], ftab_h[FORK_TABLE];
+  uint32_t fnum = 0;
   // Per-node state: pacemaker sync (volatile) + committed prefix
   // (persistent, SPEC §6c).
   std::vector<uint32_t> view_, timer, clen;     // [N]
@@ -1765,6 +1839,10 @@ struct HotstuffSim {
     gview = gtimer = gcommit = 0;
     b1_v = b1_h = b2_v = b2_h = b3_v = b3_h = -1;
     chain_view.assign(S, -1);
+    chain_vid.assign(S, 0);
+    fvec.assign(N, 0);
+    for (uint32_t k = 0; k < FORK_TABLE; ++k) ftab_v[k] = ftab_h[k] = -1;
+    fnum = 0;
     view_.assign(N, 0);
     timer.assign(N, 0);
     clen.assign(N, 0);
@@ -1777,10 +1855,25 @@ struct HotstuffSim {
         committed[size_t(n) * S + s] = 1;
         // SPEC §7b block value: a pure counter function of
         // (certifying view, height) — recomputed here exactly as the
-        // engine's extraction epilogue recomputes it.
+        // engine's extraction epilogue recomputes it. §7c: subdraw 6
+        // is the equivocating sibling variant (a forked QC's canonical
+        // side is always variant 0, so chain_vid == 1 only at
+        // non-forked byz-certified heights).
         dval[size_t(n) * S + s] = random_u32(
-            seed, STREAM_VALUE, uint32_t(chain_view[s]), 5, s);
+            seed, STREAM_VALUE, uint32_t(chain_view[s]),
+            chain_vid[s] == 1 ? 6 : 5, s);
       }
+    // §7c deceived overlays: a node holding fork entry k's fvec bit
+    // committed the SIBLING variant at that height (ascending k —
+    // later entries win, like the engine's select chain).
+    for (uint32_t k = 0; k < fnum; ++k) {
+      if (ftab_h[k] < 0) continue;
+      const uint32_t hh = uint32_t(ftab_h[k]);
+      for (uint32_t n = 0; n < N; ++n)
+        if (((fvec[n] >> k) & 1u) && hh < clen[n])
+          dval[size_t(n) * S + hh] = random_u32(
+              seed, STREAM_VALUE, uint32_t(ftab_v[k]), 6, hh);
+    }
   }
 
   void round(uint32_t r) {
@@ -1800,19 +1893,22 @@ struct HotstuffSim {
     const bool churn = churn_fires(seed, r, churn_cut);
 
     // P1 proposal: leader(gview) extends the newest QC at height
-    // b1_h + 1; silent-byzantine and down leaders withhold it.
+    // b1_h + 1. Silent-byzantine and down leaders withhold it; under
+    // SPEC §7c (equiv) a byzantine leader DOES propose — two block
+    // variants for the same (view, height), each receiver shown one.
     const uint32_t L = gview % N;
     const int32_t h_next = b1_h + 1;
-    const bool proposing = !churn && honest(L) && h_next < int32_t(S) &&
-                           !crash.is_down(L);
+    const bool eqv = equiv && n_byz > 0;
+    const bool byzL = !honest(L);
+    const bool proposing = !churn && (eqv || honest(L)) &&
+                           h_next < int32_t(S) && !crash.is_down(L);
     const bool part_active =
         random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
     const uint32_t side_L =
         random_u32(seed, STREAM_PARTITION, r, 1, L) & 1u;
     const uint32_t start_commit = gcommit;  // what the proposal carries
 
-    uint32_t votes = 0;
-    std::vector<uint8_t> pdel(N, 0);
+    std::vector<uint8_t> pdel(N, 0), evid(N, 0);
     if (proposing) {
       for (uint32_t j = 0; j < N; ++j) {
         if (crash.is_down(j)) continue;  // down receivers hear nothing
@@ -1831,25 +1927,11 @@ struct HotstuffSim {
         }
         if (!del) continue;
         pdel[j] = 1;
-        // P2 vote: receivers vote; the vote is the return flight on
-        // edge (j, L). Given delivery of the proposal, a partition
-        // cannot separate the pair again within the round — only the
-        // drop leg applies to the return edge.
-        if (honest(j)) {
-          bool vd = j == L;
-          if (!vd && net_switch) {
-            // SPEC §9: the vote routes through j's aggregator (the
-            // leader counts K pre-aggregated segments; scalar form =
-            // the factorized two-hop, phase 0).
-            vd = agg.two_hop(0, j, L);
-          } else if (!vd) {
-            bool open = delivery_u32(seed, r, j, L) >= drop_cut;
-            if (!open && max_delay)
-              open = delayed_open(seed, r, j, L, drop_cut, max_delay);
-            vd = open;
-          }
-          if (vd) ++votes;
-        }
+        // §7c per-receiver value-id: which variant the byzantine
+        // leader showed j — the pbft family's sup(r, i, j) keying.
+        // Honest leaders pin every receiver to variant 0.
+        if (eqv && byzL)
+          evid[j] = random_u32(seed, STREAM_EQUIV, r, L, j) & 1u;
         // P4 learning: the proposal carries the pacemaker view and the
         // commit state as of proposal time.
         view_[j] = gview;
@@ -1860,15 +1942,109 @@ struct HotstuffSim {
     for (uint32_t j = 0; j < N; ++j)
       if (!crash.is_down(j) && !pdel[j]) timer[j] += 1;
 
+    // P2 votes: per-variant tallies (SPEC §7c — silent mode keeps one;
+    // cnt1 stays 0 there). Byzantine replicas under equiv double-vote
+    // for BOTH variants; under §9b a byzantine replica may also LIE to
+    // its switch vertex (a claim, not a pinned value — it joins both
+    // variant queries), and a poisoned aggregator serves its forged
+    // full-segment width to both, which is how a poisoned switch
+    // vertex forges a forked QC without real double votes.
+    uint32_t cnt0 = 0, cnt1 = 0;
+    if (proposing && !net_switch) {
+      for (uint32_t j = 0; j < N; ++j) {
+        if (!pdel[j]) continue;
+        // The vote is the return flight on edge (j, L); given pdel, a
+        // partition cannot separate the pair again within the round.
+        bool vd = j == L;
+        if (!vd) {
+          bool open = delivery_u32(seed, r, j, L) >= drop_cut;
+          if (!open && max_delay)
+            open = delayed_open(seed, r, j, L, drop_cut, max_delay);
+          vd = open;
+        }
+        if (!vd) continue;
+        if (honest(j)) {
+          (eqv && evid[j] ? cnt1 : cnt0) += 1;
+        } else if (eqv) {
+          ++cnt0; ++cnt1;  // §7c maximal double-vote
+        }
+      }
+    } else if (proposing) {
+      // SPEC §9: votes route through the K aggregators (phase 0); the
+      // leader sees K pre-aggregated segment counts. Scalar twin of
+      // the engine's _count over ops/aggregate primitives.
+      const uint32_t K = agg.K;
+      std::vector<uint32_t> seg0(K, 0), seg1(K, 0);
+      bool s0 = false, s1 = false;  // the leader's local self claim
+      for (uint32_t i = 0; i < N; ++i) {
+        const bool crashed = crash.on && !crash.up[i];
+        const bool voted = pdel[i] && honest(i);
+        // §9b uplink lie: a byz node claims a vote regardless of
+        // delivery — and, under equiv, for both variants.
+        const bool claim = (!honest(i)) &&
+                           ((eqv && pdel[i]) || agg.lies(i));
+        const bool sup0 = eqv ? ((voted && evid[i] == 0) || claim)
+                              : (voted || claim);
+        const bool sup1 = eqv && ((voted && evid[i] == 1) || claim);
+        if (i == L) {
+          // The leader counts itself locally (no uplink gate); silent
+          // mode adds only its real vote, never a lie.
+          s0 = eqv ? sup0 : voted;
+          s1 = sup1;
+          continue;  // self never travels
+        }
+        if (crashed || !agg.up_edge(0, i)) continue;  // §6c: crashed
+        if (sup0) ++seg0[agg.agg_of(i)];              // liars claim nothing
+        if (sup1) ++seg1[agg.agg_of(i)];
+      }
+      const uint32_t aL = agg.agg_of(L);
+      // Leader's own aggregator poisoned+delivered: the forged width
+      // already counts L's slot — don't add the local claim.
+      const bool ownpz = agg.down(0, aL, L) && agg.poisoned(0, aL);
+      cnt0 = (s0 && !ownpz) ? 1 : 0;
+      cnt1 = (s1 && !ownpz) ? 1 : 0;
+      for (uint32_t a = 0; a < K; ++a) {
+        if (!agg.down(0, a, L)) continue;
+        if (agg.poisoned(0, a)) {
+          const uint32_t w = agg.width(a);
+          cnt0 += w;
+          if (eqv) cnt1 += w;
+          continue;
+        }
+        cnt0 += seg0[a];
+        cnt1 += seg1[a];
+      }
+    }
+
     // P3 QC-chain shift + chained 3-chain commit (consecutive views).
-    const bool qc = proposing && votes >= Q;
+    // §7c per-value QC tally: each variant needs its own quorum; BOTH
+    // reaching Q in one view is a FORKED QC — the safety violation the
+    // byzantine model deliberately re-admits. The canonical chain
+    // prefers variant 0 (deterministic tie-break, mirrored in the
+    // engine).
+    const bool qc0 = proposing && cnt0 >= Q;
+    const bool qc1 = eqv && proposing && cnt1 >= Q;
+    const bool qc = qc0 || qc1;
+    const bool forked = qc0 && qc1;
     if (qc) {
       b3_v = b2_v; b3_h = b2_h;
       b2_v = b1_v; b2_h = b1_h;
       b1_v = int32_t(gview); b1_h = h_next;
       chain_view[h_next] = int32_t(gview);
+      if (eqv) chain_vid[h_next] = qc0 ? 0 : 1;
       if (b3_v >= 0 && b1_v == b2_v + 1 && b2_v == b3_v + 1)
         gcommit = std::max(gcommit, uint32_t(b3_h + 1));
+    }
+    // §7c fork-certificate table: record (view, height) and mark every
+    // honest receiver shown the NON-canonical variant — those nodes
+    // durably believe the sibling block sits at this height.
+    if (forked && fnum < FORK_TABLE) {
+      ftab_v[fnum] = int32_t(gview);
+      ftab_h[fnum] = h_next;
+      for (uint32_t j = 0; j < N; ++j)
+        if (pdel[j] && honest(j) && evid[j] == 1)
+          fvec[j] |= (1u << fnum);
+      ++fnum;
     }
 
     // P5 pacemaker: QC advances the view; else timeout after
@@ -1903,9 +2079,23 @@ bool valid_switch(uint32_t net_switch, uint32_t n_aggregators,
   return n_aggregators >= 1 && n_aggregators <= n_nodes;
 }
 
+// SPEC §9b poison-knob validation (mirrors core/config.py): flat
+// forbids every §9b knob; under switch the byzantine aggregators are a
+// tail of [0, K] and a poison rate needs at least one of them.
+bool valid_poison(uint32_t net_switch, uint32_t n_aggregators,
+                  uint32_t agg_byz, uint32_t agg_poison_cut,
+                  uint32_t byz_uplink_cut) {
+  if (!net_switch)
+    return agg_byz == 0 && agg_poison_cut == 0 && byz_uplink_cut == 0;
+  if (agg_byz > n_aggregators) return false;
+  return agg_poison_cut == 0 || agg_byz > 0;
+}
+
 bool valid_switch(const SimConfig& c) {
   return valid_switch(c.net_switch, c.n_aggregators, c.n_nodes,
-                      c.agg_fail_cut, c.agg_stale_cut, c.agg_max_stale);
+                      c.agg_fail_cut, c.agg_stale_cut, c.agg_max_stale) &&
+         valid_poison(c.net_switch, c.n_aggregators, c.agg_byz,
+                      c.agg_poison_cut, c.byz_uplink_cut);
 }
 
 class RaftEngine final : public Engine {
@@ -1913,7 +2103,8 @@ class RaftEngine final : public Engine {
   const char* name() const override { return "raft"; }
   int run(const SimConfig& c) override {
     if (c.n_nodes == 0 || c.t_max <= c.t_min || c.max_active > c.n_nodes ||
-        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c))
+        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c) ||
+        c.agg_byz || c.agg_poison_cut || c.byz_uplink_cut)  // §9b: BFT only
       return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.L = c.log_capacity; sim_.E = c.max_entries;
@@ -1991,6 +2182,9 @@ class PbftEngine final : public SlotEngine<PbftSim> {
     sim_.net_switch = c.net_switch; sim_.n_agg = c.n_aggregators;
     sim_.agg_fail_cut = c.agg_fail_cut; sim_.agg_stale_cut = c.agg_stale_cut;
     sim_.agg_max_stale = c.agg_max_stale;
+    sim_.agg.agg_byz = c.agg_byz;           // SPEC §9b
+    sim_.agg.poison_cut = c.agg_poison_cut;
+    sim_.agg.uplink_cut = c.byz_uplink_cut;
     sim_.run();
     return 0;
   }
@@ -2006,7 +2200,8 @@ class PaxosEngine final : public SlotEngine<PaxosSim> {
   const char* name() const override { return "paxos"; }
   int run(const SimConfig& c) override {
     if (c.n_nodes == 0 || c.log_capacity == 0 ||
-        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c))
+        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c) ||
+        c.agg_byz || c.agg_poison_cut || c.byz_uplink_cut)  // §9b: BFT only
       return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity;
@@ -2039,6 +2234,7 @@ class HotstuffEngine final : public SlotEngine<HotstuffSim> {
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity; sim_.f = c.f;
     sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
+    sim_.equiv = c.byz_equivocate;  // SPEC §7c fork model
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
     sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
@@ -2046,6 +2242,9 @@ class HotstuffEngine final : public SlotEngine<HotstuffSim> {
     sim_.net_switch = c.net_switch; sim_.n_agg = c.n_aggregators;
     sim_.agg_fail_cut = c.agg_fail_cut; sim_.agg_stale_cut = c.agg_stale_cut;
     sim_.agg_max_stale = c.agg_max_stale;
+    sim_.agg.agg_byz = c.agg_byz;           // SPEC §9b
+    sim_.agg.poison_cut = c.agg_poison_cut;
+    sim_.agg.uplink_cut = c.byz_uplink_cut;
     sim_.run();
     return 0;
   }
@@ -2180,6 +2379,8 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t net_switch,     // SPEC §9 switch model
                   uint32_t n_aggregators, uint32_t agg_fail_cut,
                   uint32_t agg_stale_cut, uint32_t agg_max_stale,
+                  uint32_t agg_byz,        // SPEC §9b poisoned combines
+                  uint32_t agg_poison_cut, uint32_t byz_uplink_cut,
                   uint8_t* out_committed,   // [N*S]
                   uint32_t* out_dval,       // [N*S]
                   uint32_t* out_view) {     // [N]
@@ -2187,7 +2388,9 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
       max_delay > 16)
     return 1;
   if (!ctpu::valid_switch(net_switch, n_aggregators, n_nodes,
-                          agg_fail_cut, agg_stale_cut, agg_max_stale))
+                          agg_fail_cut, agg_stale_cut, agg_max_stale) ||
+      !ctpu::valid_poison(net_switch, n_aggregators, agg_byz,
+                          agg_poison_cut, byz_uplink_cut))
     return 1;
   ctpu::PbftSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
@@ -2201,6 +2404,9 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.net_switch = net_switch; sim.n_agg = n_aggregators;
   sim.agg_fail_cut = agg_fail_cut; sim.agg_stale_cut = agg_stale_cut;
   sim.agg_max_stale = agg_max_stale;
+  sim.agg.agg_byz = agg_byz;           // SPEC §9b
+  sim.agg.poison_cut = agg_poison_cut;
+  sim.agg.uplink_cut = byz_uplink_cut;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_committed, sim.committed.data(), ns);
@@ -2286,7 +2492,8 @@ int ctpu_dpos_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
 
 int ctpu_hotstuff_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                       uint32_t n_slots, uint32_t f, uint32_t view_timeout,
-                      uint32_t n_byzantine,  // SPEC §7b silent minority
+                      uint32_t n_byzantine,  // SPEC §7b byzantine minority
+                      uint32_t byz_equivocate,  // SPEC §7c fork model
                       uint32_t drop_cut, uint32_t part_cut,
                       uint32_t churn_cut,
                       uint32_t crash_cut, uint32_t recover_cut,  // SPEC §6c
@@ -2295,23 +2502,31 @@ int ctpu_hotstuff_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                       uint32_t net_switch,     // SPEC §9 switch model
                       uint32_t n_aggregators, uint32_t agg_fail_cut,
                       uint32_t agg_stale_cut, uint32_t agg_max_stale,
+                      uint32_t agg_byz,        // SPEC §9b poisoned combines
+                      uint32_t agg_poison_cut, uint32_t byz_uplink_cut,
                       uint8_t* out_committed,   // [N*S]
                       uint32_t* out_dval,       // [N*S]
                       uint32_t* out_clen,       // [N]
                       uint32_t* out_view) {     // [N]
   if (n_nodes != 3 * f + 1 || n_byzantine > f || max_delay > 16) return 1;
   if (!ctpu::valid_switch(net_switch, n_aggregators, n_nodes,
-                          agg_fail_cut, agg_stale_cut, agg_max_stale))
+                          agg_fail_cut, agg_stale_cut, agg_max_stale) ||
+      !ctpu::valid_poison(net_switch, n_aggregators, agg_byz,
+                          agg_poison_cut, byz_uplink_cut))
     return 1;
   ctpu::HotstuffSim sim;
   sim.seed = seed; sim.N = n_nodes; sim.R = n_rounds; sim.S = n_slots;
   sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
+  sim.equiv = byz_equivocate;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
   sim.max_crashed = max_crashed; sim.max_delay = max_delay;
   sim.net_switch = net_switch; sim.n_agg = n_aggregators;
   sim.agg_fail_cut = agg_fail_cut; sim.agg_stale_cut = agg_stale_cut;
   sim.agg_max_stale = agg_max_stale;
+  sim.agg.agg_byz = agg_byz;           // SPEC §9b
+  sim.agg.poison_cut = agg_poison_cut;
+  sim.agg.uplink_cut = byz_uplink_cut;
   sim.run();
   size_t ns = size_t(n_nodes) * n_slots;
   std::memcpy(out_committed, sim.committed.data(), ns);

@@ -22,7 +22,8 @@ int ctpu_raft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
 int ctpu_pbft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  uint32_t,
                   uint8_t*, uint32_t*, uint32_t*);
 int ctpu_paxos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                    uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
@@ -36,7 +37,8 @@ int ctpu_dpos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
 int ctpu_hotstuff_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t,
                       uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                       uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                      uint32_t, uint32_t, uint32_t, uint32_t,
+                      uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                      uint32_t, uint32_t, uint32_t,
                       uint8_t*, uint32_t*, uint32_t*, uint32_t*);
 }
 
@@ -162,27 +164,27 @@ int main() {
     size_t W = (ns + 3) / 4 + ns + N;
     rc |= run_twice("pbft", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     rc |= run_twice("pbft-equiv", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // SPEC §6b broadcast-atomic fault model, with equivocation.
     rc |= run_twice("pbft-bcast", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, 0, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // §6 edge model: dense vs forced edge-wise delivery queries.
     rc |= run_match("pbft-delivery", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, d, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -190,7 +192,7 @@ int main() {
     // direct per-receiver definition (forced dense).
     rc |= run_match("pbft-bcast-agg", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -198,6 +200,7 @@ int main() {
     rc |= run_match("pbft-bcast-crash", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d,
                            CRASH, REC, 2, 3, /*§9 flat*/ 0, 0, 0, 0, 1,
+                           /*§9b flat*/ 0, 0, 0,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -209,15 +212,27 @@ int main() {
     size_t ns = size_t(N) * S;
     size_t W = (ns + 3) / 4 + ns + N + N;
     rc |= run_twice("hotstuff", W, [&](uint32_t* o) {
-      return ctpu_hotstuff_run(33, N, R, S, f, 8, 1, DROP, PART, CHURN,
+      return ctpu_hotstuff_run(33, N, R, S, f, 8, 1, 0, DROP, PART, CHURN,
                                0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
+                               /*§9b flat*/ 0, 0, 0,
+                               reinterpret_cast<uint8_t*>(o),
+                               o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
+                               o + (ns + 3) / 4 + ns + N);
+    });
+    // SPEC §7c per-receiver equivocation: byzantine proposers hand each
+    // receiver a value-id stance; QC tallies go per-value.
+    rc |= run_twice("hotstuff-equiv", W, [&](uint32_t* o) {
+      return ctpu_hotstuff_run(33, N, R, S, f, 8, 2, 1, DROP, PART, CHURN,
+                               0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
+                               /*§9b flat*/ 0, 0, 0,
                                reinterpret_cast<uint8_t*>(o),
                                o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                o + (ns + 3) / 4 + ns + N);
     });
     rc |= run_twice("hotstuff-crash-delay", W, [&](uint32_t* o) {
-      return ctpu_hotstuff_run(33, N, R, S, f, 8, 0, DROP, PART, CHURN,
+      return ctpu_hotstuff_run(33, N, R, S, f, 8, 0, 0, DROP, PART, CHURN,
                                CRASH, REC, 2, 4, /*§9 flat*/ 0, 0, 0, 0, 1,
+                               /*§9b flat*/ 0, 0, 0,
                                reinterpret_cast<uint8_t*>(o),
                                o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                o + (ns + 3) / 4 + ns + N);
@@ -292,6 +307,7 @@ int main() {
         return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN,
                              0, CRASH, REC, 2, 2,
                              /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
+                             /*§9b off*/ 0, 0, 0,
                              reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                              o + (ns + 3) / 4 + ns);
       });
@@ -299,6 +315,7 @@ int main() {
         return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN,
                              0, 0, 0, 0, 2,
                              /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
+                             /*§9b off*/ 0, 0, 0,
                              reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                              o + (ns + 3) / 4 + ns);
       });
@@ -322,13 +339,48 @@ int main() {
       size_t ns = size_t(N) * S;
       size_t W = (ns + 3) / 4 + ns + N + N;
       rc |= run_twice("hotstuff-switch", W, [&](uint32_t* o) {
-        return ctpu_hotstuff_run(33, N, R, S, f, 4, 1, DROP, PART, CHURN,
+        return ctpu_hotstuff_run(33, N, R, S, f, 4, 1, 0, DROP, PART, CHURN,
                                  CRASH, REC, 2, 2,
                                  /*§9 switch*/ 1, 2, AGGF, AGGS, 4,
+                                 /*§9b off*/ 0, 0, 0,
                                  reinterpret_cast<uint8_t*>(o),
                                  o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                  o + (ns + 3) / 4 + ns + N);
       });
+    }
+    // SPEC §9b poisoned aggregation: byzantine combine forgery on a
+    // tail aggregator plus lying uplinks, composed with §7c
+    // equivocation — the silent-fork axes under sanitizers.
+    {
+      const uint32_t AGGP = 1717986918u, UPL = 858993459u;  // ~40%, ~20%
+      const uint32_t f = 2, N = 3 * f + 1;
+      {
+        const uint32_t R = 48, S = 16;
+        size_t ns = size_t(N) * S;
+        size_t W = (ns + 3) / 4 + ns + N;
+        rc |= run_twice("pbft-poison", W, [&](uint32_t* o) {
+          return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN,
+                               0, CRASH, REC, 2, 2,
+                               /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
+                               /*§9b*/ 1, AGGP, UPL,
+                               reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
+                               o + (ns + 3) / 4 + ns);
+        });
+      }
+      {
+        const uint32_t R = 96, S = 64;
+        size_t ns = size_t(N) * S;
+        size_t W = (ns + 3) / 4 + ns + N + N;
+        rc |= run_twice("hotstuff-poison", W, [&](uint32_t* o) {
+          return ctpu_hotstuff_run(33, N, R, S, f, 4, 2, 1, DROP, PART, CHURN,
+                                   CRASH, REC, 2, 2,
+                                   /*§9 switch*/ 1, 2, AGGF, AGGS, 4,
+                                   /*§9b*/ 1, AGGP, UPL,
+                                   reinterpret_cast<uint8_t*>(o),
+                                   o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
+                                   o + (ns + 3) / 4 + ns + N);
+        });
+      }
     }
   }
   if (rc == 0) std::printf("selftest: ALL CLEAN\n");

@@ -23,6 +23,7 @@ constexpr uint32_t STREAM_CRASH     = 0x68E31DA5u;  // SPEC §6c (mirrored)
 constexpr uint32_t STREAM_SLOTMISS  = 0x7F4A7C15u;  // SPEC §A.1 DPoS slot miss
 constexpr uint32_t STREAM_DELAY     = 0x2545F491u;  // SPEC §A.2 retransmit
 constexpr uint32_t STREAM_AGG       = 0x510E527Fu;  // SPEC §9 aggregator faults
+constexpr uint32_t STREAM_POISON    = 0x6A09E667u;  // SPEC §9b poisoned combines
 constexpr uint32_t STREAM_SUPPRESS  = 0x1F83D9ABu;  // SPEC §A.4 producer runs
 
 inline uint32_t rotl32(uint32_t x, int r) {

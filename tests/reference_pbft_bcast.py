@@ -34,12 +34,13 @@ from consensus_tpu.engines.pbft_bcast import _extract, _pspec
 from consensus_tpu.network.runner import EngineDef
 from consensus_tpu.ops.aggregate import agg_counts
 from consensus_tpu.ops.adversary import (crash_counts, crash_transition,
-                                         freeze_down)
+                                         freeze_down, safety_counts)
 from consensus_tpu.ops.adversary import draw as _draw
 from consensus_tpu.ops.adversary import cutoff as _lt
 from consensus_tpu.ops.adversary import bitcast_i32 as _i32
 
 I32_MAX = jnp.iinfo(jnp.int32).max
+I32_MIN = jnp.iinfo(jnp.int32).min
 
 
 class _SortedTally:
@@ -113,9 +114,6 @@ def sorted_tally_round(cfg: Config, st: PbftState, r, *,
         return ~part_active | (side == b)
 
     equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
-    if equiv:
-        stance = (_draw(seed, rng.STREAM_EQUIV, ur, uidx,
-                        jnp.uint32(0x80000000)) & jnp.uint32(1)).astype(bool)
 
     view, timer = st.view, st.timer
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
@@ -180,9 +178,14 @@ def sorted_tally_round(cfg: Config, st: PbftState, r, *,
     pm_val = msg_val[prim]
     if equiv:
         prim_byz = byz[prim]
+        # Per-receiver fork (SPEC §7c): sup(r, prim(j), j) picks which
+        # conflicting value the byz primary pre-prepares at receiver j.
+        sup_prim = (_draw(seed, rng.STREAM_EQUIV, ur,
+                          prim.astype(jnp.uint32), uidx)
+                    & jnp.uint32(1)).astype(bool)
         bval = _i32(_draw(seed, rng.STREAM_VALUE,
                           view[:, None].astype(jnp.uint32),
-                          jnp.where(stance[prim], 4, 3)[:, None]
+                          jnp.where(sup_prim, 4, 3)[:, None]
                           .astype(jnp.uint32),
                           sarange[None, :].astype(jnp.uint32)))
         prim_ok = jnp.where(prim_byz, prim_del, prim_ok)
@@ -197,16 +200,16 @@ def sorted_tally_round(cfg: Config, st: PbftState, r, *,
 
     # ---- P4 + P5 tallies in sorted space with the retired unsort.
     if equiv:
-        eq_send = byz & bcast & stance
-        if no_part:
-            extra = jnp.broadcast_to(jnp.sum(eq_send.astype(jnp.int32)),
-                                     (N,))
-        else:
-            extra = jnp.stack(
-                [jnp.sum((eq_send & side_ok(0)).astype(jnp.int32)),
-                 jnp.sum((eq_send & side_ok(1)).astype(jnp.int32))
-                 ])[side]                                        # [N]
-        extra = extra - (eq_send).astype(jnp.int32)
+        # Per-receiver claims (SPEC §7c), full [N, N] grid — this
+        # reference is a test fixture; the production round keeps the
+        # grid at [n_byzantine, N].
+        supg = (_draw(seed, rng.STREAM_EQUIV, ur, uidx[:, None],
+                      uidx[None, :]) & jnp.uint32(1)).astype(bool)
+        sendg = (supg & (byz & bcast)[:, None]
+                 & (idx[:, None] != idx[None, :]))
+        if not no_part:
+            sendg &= ~part_active | (side[:, None] == side[None, :])
+        extra = jnp.sum(sendg.astype(jnp.int32), axis=0)         # [N]
         extra_sn = jnp.broadcast_to(extra[:, None], (N, S)).T
     else:
         extra_sn = None
@@ -303,9 +306,25 @@ def sorted_tally_round(cfg: Config, st: PbftState, r, *,
     # and is only ever compared against flat-mode runs, where the
     # production counters are identically zero too).
     az = agg_counts()
+    # SPEC §7c safety tail — same reductions as the production kernel
+    # (engines/pbft_bcast.py). The retired round is flat-only, so the
+    # poison axes are structurally off and `equiv` alone gates the math.
+    if equiv:
+        nw = commit_now & honest[:, None]
+        forked = (jnp.any(nw, axis=0)
+                  & (jnp.max(jnp.where(nw, pp_val, I32_MIN), axis=0)
+                     != jnp.min(jnp.where(nw, pp_val, I32_MAX), axis=0)))
+        cm = committed & honest[:, None]
+        conflicts = (jnp.any(cm, axis=0)
+                     & (jnp.max(jnp.where(cm, dval, I32_MIN), axis=0)
+                        != jnp.min(jnp.where(cm, dval, I32_MAX), axis=0)))
+        sz = safety_counts(forked, conflicts)
+    else:
+        sz = safety_counts()
     vec = jnp.stack([cnt(prep_new_s), cnt(prep_miss_s), cnt(commit_now_s),
                      cnt(commit_miss_s), cnt(adopt),
-                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az])
+                     jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az,
+                     *sz])
     return new, vec
 
 

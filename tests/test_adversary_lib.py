@@ -365,6 +365,12 @@ SCENARIO_SHAPES = {
     "discovered-compound-quorum-starvation": Config(
         protocol="raft", n_nodes=7, n_rounds=96, log_capacity=128,
         max_entries=96, n_sweeps=2, seed=11),
+    # the §7c/§9b silent safety break: poisoned aggregator + lying
+    # uplinks fork hotstuff QCs at availability 1.0 — tuned shape from
+    # the hotstuff-forked-qc space, promoted across seeds 11/23/37.
+    "discovered-silent-qc-fork": Config(
+        protocol="hotstuff", f=2, n_nodes=7, n_rounds=96,
+        log_capacity=96, view_timeout=4, n_sweeps=2, seed=11),
 }
 
 

@@ -275,14 +275,16 @@ def test_space_definitions_are_gate_representative():
                  "recover_prob": sp.base.crash_on,
                  "miss_rate": sp.base.miss_on,
                  "partition_rate": not sp.base.no_partition,
-                 "attack_rate": sp.base.attack != "none"}
+                 "attack_rate": sp.base.attack != "none",
+                 "agg_poison_rate": sp.base.agg_poison_on,
+                 "byz_uplink_rate": sp.base.uplink_lies_on}
         for k in sp.knobs:
             assert gates.get(k.field, True), (sp.name, k.field)
         assert sp.base.n_nodes <= 2048
         # Commit supply outlives the run (fitness-signal hygiene).
         if sp.base.protocol == "raft":
             assert sp.base.max_entries >= sp.base.n_rounds
-        elif sp.base.protocol in ("pbft", "paxos", "dpos"):
+        elif sp.base.protocol in ("pbft", "paxos", "dpos", "hotstuff"):
             assert sp.base.log_capacity >= sp.base.n_rounds
 
 

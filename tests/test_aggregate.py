@@ -184,7 +184,8 @@ def test_flat_defaults_bit_identical(name):
     doc = json.loads(cfg.to_json())
     for field in ("net_model", "n_aggregators", "agg_fail_rate",
                   "agg_stale_rate", "agg_max_stale", "suppress_rate",
-                  "suppress_window"):
+                  "suppress_window", "agg_byz", "agg_poison_rate",
+                  "byz_uplink_rate"):
         doc.pop(field)
     old_style = Config.from_json(json.dumps(doc))
     assert old_style == cfg

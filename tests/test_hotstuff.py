@@ -48,10 +48,11 @@ def test_hotstuff_config_shape_and_byz_rules():
         dataclasses.replace(BASE, n_nodes=8)
     with pytest.raises(ValueError, match="n_byzantine"):
         dataclasses.replace(BASE, n_byzantine=3)  # > f = 2
-    # The engine counts votes — an equivocation stance has no per-value
-    # tally to poison, so the mode is rejected, not silently ignored.
-    with pytest.raises(ValueError, match="silent"):
-        dataclasses.replace(BASE, n_byzantine=1, byz_mode="equivocate")
+    # SPEC §7c: equivocation is a real hotstuff mode now — a byzantine
+    # leader proposes two block variants and the engine keeps per-value
+    # QC tallies (the former counts-only rejection is lifted).
+    cfg = dataclasses.replace(BASE, n_byzantine=1, byz_mode="equivocate")
+    assert cfg.byz_mode == "equivocate"
     # bcast is the §6b pbft fault model; hotstuff delivery is already a
     # star of O(N) edges.
     with pytest.raises(ValueError, match="bcast"):

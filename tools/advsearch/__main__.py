@@ -115,6 +115,58 @@ def cmd_distill(args) -> int:
 
 
 DEFAULT_REPORT = "benchmarks/parts/attack_findings.json"
+DEFAULT_BUDGETS = "benchmarks/parts/search_budgets.json"
+
+
+def cmd_promote(args) -> int:
+    from consensus_tpu import scenarios as scen
+
+    from .search import promote
+    catalog = args.catalog or str(
+        pathlib.Path(scen.__file__).with_name("discovered.json"))
+    seeds = tuple(int(x) for x in args.seeds.split(",") if x.strip())
+    try:
+        rec = promote(args.name, catalog, seeds=seeds,
+                      n_sweeps=args.sweeps, log=_log)
+    except ValueError as exc:
+        raise SystemExit(f"advsearch: {exc}")
+    _log(f"scenario {args.name!r} PROMOTED: bounds held on all "
+         f"{len(seeds)} fresh seeds — tools/check.py's scenario layer "
+         "now runs it as a CI smoke")
+    print(json.dumps({"name": args.name, "promoted": rec}))
+    return 0
+
+
+def cmd_budget(args) -> int:
+    from .search import budget_path
+    out = args.out or str(
+        pathlib.Path(__file__).resolve().parents[2] / DEFAULT_BUDGETS)
+    p = pathlib.Path(out)
+    doc = {"version": 1, "rows": []}
+    if p.exists():
+        doc = json.loads(p.read_text())
+    rows = {(r["space"], r["search_seed"]): r
+            for r in doc.get("rows", [])}
+    for sd in args.state_dir:
+        bp = budget_path(sd)
+        if not bp.exists():
+            raise SystemExit(
+                f"advsearch: no search_budget.json in {sd} — the "
+                "sidecar is written per generation by `search "
+                "--state-dir`; run a search there first")
+        row = json.loads(bp.read_text())
+        rows[(row["space"], row["search_seed"])] = row
+    doc["rows"] = sorted(rows.values(),
+                         key=lambda r: (r["space"], r["search_seed"]))
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp.json")
+    tmp.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    tmp.replace(p)
+    _log(f"{len(args.state_dir)} search budget(s) folded into {out} "
+         f"({len(doc['rows'])} rows total); tools/ledger.py ingests "
+         "them as adv-search LEDGER rows")
+    print(json.dumps({"rows": len(doc["rows"]), "out": out}))
+    return 0
 
 
 def _load_state_by_identity(state_dir):
@@ -297,13 +349,43 @@ def main(argv=None) -> int:
                             "advsearch-smoke` gate)")
     m.add_argument("--trace-out", default="")
 
+    p = sub.add_parser("promote",
+                       help="re-run a distilled catalog scenario across "
+                            "K fresh seeds at its tuned shape; mark it "
+                            "promoted (a `make check` scenario smoke) "
+                            "only if the bounds hold on EVERY seed")
+    p.add_argument("--name", required=True,
+                   help="catalog entry to promote (discovered.json)")
+    p.add_argument("--seeds", default="11,23,37",
+                   help="comma-separated fresh seeds the bounds must "
+                        "hold on (all of them, or no promotion)")
+    p.add_argument("--sweeps", type=int, default=2,
+                   help="n_sweeps per promotion run")
+    p.add_argument("--catalog", default="",
+                   help="catalog JSON path (default: the package's "
+                        "consensus_tpu/scenarios/discovered.json)")
+
+    b = sub.add_parser("budget",
+                       help="fold per-search cost sidecars "
+                            "(search_budget.json, written next to the "
+                            "search state) into the committed "
+                            "search-budgets artifact tools/ledger.py "
+                            "ingests as adv-search rows")
+    b.add_argument("--state-dir", action="append", required=True,
+                   help="search state dir to fold (repeatable; rows "
+                        "keyed by (space, search_seed), atomic replace)")
+    b.add_argument("--out", default="",
+                   help=f"budgets JSON path (default <repo>/"
+                        f"{DEFAULT_BUDGETS})")
+
     args = ap.parse_args(argv)
     if args.cmd == "search" and args.resume and not args.state_dir:
         ap.error("--resume needs --state-dir (there is no state to "
                  "resume without one)")
     return {"spaces": cmd_spaces, "search": cmd_search,
             "distill": cmd_distill, "report": cmd_report,
-            "smoke": cmd_smoke}[args.cmd](args)
+            "smoke": cmd_smoke, "promote": cmd_promote,
+            "budget": cmd_budget}[args.cmd](args)
 
 
 if __name__ == "__main__":

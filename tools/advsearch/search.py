@@ -38,6 +38,11 @@ RATE_CUTOFFS = {
     "miss_rate": "miss_cutoff",
     "suppress_rate": "suppress_cutoff",
     "attack_rate": "attack_cutoff",
+    # SPEC §9b vote-certificate byzantine knobs (pbft/hotstuff switch
+    # models): forged combines from byzantine aggregators, byzantine
+    # replicas lying to their switch vertex.
+    "agg_poison_rate": "agg_poison_cutoff",
+    "byz_uplink_rate": "byz_uplink_cutoff",
 }
 
 # STREAM_SEARCH subdraw selectors (c0); c1 packs (candidate, knob) as
@@ -197,6 +202,64 @@ SPACES: dict[str, Space] = {s.name: s for s in (
                KnobRange("partition_rate", 0.0, 0.40),
                KnobRange("churn_rate", 0.0, 0.15))),
     Space(
+        name="pbft-cert-poison",
+        description="SPEC §9b poisoned vote certificates (pbft over the "
+                    "switch fabric): 2 equivocating replicas lie to "
+                    "their aggregator vertex with byz_uplink_rate while "
+                    "1 of the 2 aggregators serves forged full-support "
+                    "combines with agg_poison_rate, under light drops — "
+                    "hunting compositions where a forged certificate "
+                    "crosses the commit quorum and the §7c safety "
+                    "counters fire (forked_qc / conflict_commits at "
+                    "HONEST nodes), not merely a liveness dip.",
+        base=Config(protocol="pbft", f=2, n_nodes=7, log_capacity=96,
+                    net_model="switch", n_aggregators=2, agg_byz=1,
+                    n_byzantine=2, byz_mode="equivocate",
+                    agg_poison_rate=0.3, byz_uplink_rate=0.2,
+                    drop_rate=0.1, **_ADV),
+        knobs=(KnobRange("agg_poison_rate", 0.05, 0.95),
+               KnobRange("byz_uplink_rate", 0.05, 0.95),
+               KnobRange("drop_rate", 0.0, 0.40))),
+    Space(
+        name="hotstuff-forked-qc",
+        description="SPEC §7c x §9b: an equivocating hotstuff leader "
+                    "(dual block variants, per-value QC tallies) over a "
+                    "half-poisoned switch fabric — the byzantine "
+                    "aggregator inflates BOTH variants' tallies toward "
+                    "full segment support, so the search hunts the "
+                    "poison/uplink/drop composition that forges a "
+                    "forked QC (two certificates at one height) or "
+                    "conflicting honest commits, at a short pacemaker "
+                    "timeout.",
+        base=Config(protocol="hotstuff", f=2, n_nodes=7,
+                    log_capacity=96, view_timeout=4, net_model="switch",
+                    n_aggregators=2, agg_byz=1, n_byzantine=2,
+                    byz_mode="equivocate", agg_poison_rate=0.3,
+                    byz_uplink_rate=0.2, drop_rate=0.1, **_ADV),
+        knobs=(KnobRange("agg_poison_rate", 0.05, 0.95),
+               KnobRange("byz_uplink_rate", 0.05, 0.95),
+               KnobRange("drop_rate", 0.0, 0.40))),
+    Space(
+        name="pbft-quorum-1k",
+        description="The pbft-quorum composition at the SPEC §6b big-N "
+                    "broadcast fault model (N = 1024, f = 341): "
+                    "per-sender broadcast drops, partitions, churn and "
+                    "§6c crash waves at a four-digit population — does "
+                    "the N = 7 space's compound quorum starvation "
+                    "survive the law of large numbers, or does the "
+                    "f-ladder's slack absorb it? Oracle replays stay "
+                    "seconds-class (docs/RESILIENCE.md §8).",
+        base=Config(protocol="pbft", f=341, n_nodes=1024,
+                    fault_model="bcast", log_capacity=96, drop_rate=0.3,
+                    partition_rate=0.1, churn_rate=0.02, crash_prob=0.1,
+                    recover_prob=0.3, max_crashed=64,
+                    max_delay_rounds=2, **_ADV),
+        knobs=(KnobRange("drop_rate", 0.05, 0.60),
+               KnobRange("partition_rate", 0.0, 0.40),
+               KnobRange("churn_rate", 0.0, 0.15),
+               KnobRange("crash_prob", 0.0, 0.30),
+               KnobRange("recover_prob", 0.05, 0.50))),
+    Space(
         name="raft-attack-elect",
         description="SPEC §A.3 repeated election disruption: how low "
                     "an attack_rate still denies liveness. TPU-only "
@@ -314,13 +377,18 @@ def budget_of(space: Space, knobs: dict[str, float]) -> float:
 
 def severity_of(metrics: dict[str, Any]) -> float:
     """Scalar liveness damage from one lane's fitness signals
-    (obs/timeline.lane_fitness [+ lib_ratio for dpos])."""
+    (obs/timeline.lane_fitness [+ lib_ratio for dpos]). A SAFETY
+    violation (SPEC §7c forked QC / conflicting commits at honest
+    nodes) dominates every liveness term: agreement is the invariant,
+    availability merely the service level."""
     sev = (1.0 - metrics["availability"]) + 0.5 * metrics["stall_ratio"]
     if metrics["never_recovered"]:
         sev += 1.0
     lib = metrics.get("lib_ratio")
     if lib is not None:
         sev += 1.0 - lib
+    if metrics.get("safety_violations"):
+        sev += 3.0
     return round(sev, 6)
 
 
@@ -332,10 +400,14 @@ def coverage_key(metrics: dict[str, Any]) -> str:
     coverage-guided rather than pure hill-climbing."""
     dec = lambda x: min(9, int(x * 10))  # noqa: E731
     lib = metrics.get("lib_ratio")
-    return "a{}s{}n{}l{}".format(
+    viol = metrics.get("safety_violations")
+    return "a{}s{}n{}l{}v{}".format(
         dec(metrics["availability"]), dec(metrics["stall_ratio"]),
         int(metrics["never_recovered"]),
-        "-" if lib is None else dec(lib))
+        "-" if lib is None else dec(lib),
+        # Safety cell: absent counters (non-BFT engines) vs clean vs
+        # violated — a first safety break always opens a new cell.
+        "-" if viol is None else min(9, viol))
 
 
 # --- search state -----------------------------------------------------------
@@ -385,6 +457,53 @@ def save_state(state_dir, st: SearchState) -> None:
     tmp = p.with_suffix(".tmp.json")
     tmp.write_text(json.dumps(st.to_doc(), indent=2, sort_keys=True))
     tmp.replace(p)
+
+
+BUDGET_VERSION = 1
+
+
+def budget_path(state_dir) -> pathlib.Path:
+    return pathlib.Path(state_dir) / "search_budget.json"
+
+
+def budget_doc(st: SearchState, wall_s: float) -> dict:
+    """One search's COST record: generation/evaluation totals plus wall
+    time. Lives in a sidecar OUTSIDE search_state.json on purpose — the
+    state file is part of the determinism contract (same seed ⇒
+    byte-identical state, tests/test_advsearch.py compares `to_doc()`
+    across fresh runs), and wall clock is exactly the thing that can
+    never be deterministic. `python -m tools.advsearch budget` folds
+    sidecars into benchmarks/parts/search_budgets.json, which
+    tools/ledger.py ingests as `adv-search` LEDGER rows."""
+    return {"version": BUDGET_VERSION, "space": st.space,
+            "search_seed": st.search_seed, "population": st.population,
+            "generations": st.generations_done,
+            "evals": st.generations_done * st.population,
+            "findings": len(st.findings),
+            "coverage_cells": len(st.coverage),
+            "wall_s": round(float(wall_s), 3)}
+
+
+def save_budget(state_dir, st: SearchState, wall_s: float) -> None:
+    p = budget_path(state_dir)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp.json")
+    tmp.write_text(json.dumps(budget_doc(st, wall_s), indent=2,
+                              sort_keys=True))
+    tmp.replace(p)
+
+
+def load_budget_wall(state_dir, st: SearchState) -> float:
+    """Accumulated wall seconds a RESUMED search should continue from —
+    0 when no sidecar exists or it belongs to a different search."""
+    p = budget_path(state_dir)
+    if not p.exists():
+        return 0.0
+    doc = json.loads(p.read_text())
+    if (doc.get("space"), doc.get("search_seed")) != (st.space,
+                                                      st.search_seed):
+        return 0.0
+    return float(doc.get("wall_s", 0.0))
 
 
 def load_state(state_dir, space: Space, search_seed: int,
@@ -497,6 +616,10 @@ def run_search(space: Space, *, search_seed: int, generations: int,
     if st is None:
         st = SearchState(space=space.name, search_seed=search_seed,
                          population=population, params=params)
+    import time as _time
+    wall0 = (load_budget_wall(state_dir, st)
+             if state_dir is not None else 0.0)
+    t0 = _time.perf_counter()
 
     base = _dc.replace(space.base, n_sweeps=population)
     eng = simulator.engine_def(base)
@@ -527,7 +650,10 @@ def run_search(space: Space, *, search_seed: int, generations: int,
             hurt = (m["availability"] <= max_availability
                     or m["never_recovered"]
                     or (m.get("lib_ratio") is not None
-                        and m["lib_ratio"] <= max_lib_ratio))
+                        and m["lib_ratio"] <= max_lib_ratio)
+                    # A safety break is ALWAYS a finding, whatever the
+                    # liveness numbers look like (SPEC §7c).
+                    or bool(m.get("safety_violations")))
             # One finding per coverage cell: `novel` bounds the archive
             # by the behavior map (and with it the oracle-replay cost),
             # and keeps the findings DIVERSE — thousands of near-copies
@@ -558,6 +684,7 @@ def run_search(space: Space, *, search_seed: int, generations: int,
             f"{len(st.findings)} findings total")
         if state_dir is not None:
             save_state(state_dir, st)
+            save_budget(state_dir, st, wall0 + _time.perf_counter() - t0)
     return st
 
 
@@ -665,6 +792,24 @@ def _bounds_from_metrics(m: dict[str, Any]) -> dict[str, Any]:
         b["max_recovery_rounds"] = int(m["recovery_rounds"] * 4)
     if m.get("lib_ratio") is not None:
         b["max_lib_ratio"] = round(min(0.95, m["lib_ratio"] + 0.2), 3)
+    if m.get("safety_violations"):
+        # A SAFETY finding asserts the invariant break itself, not just
+        # its liveness shadow: the distilled scenario must reproduce at
+        # least one violated window (TimelineBounds.min_counters totals
+        # the flight counter across sweeps), and each specific
+        # violation kind the lane showed must re-appear.
+        mc: dict[str, int] = {"safety_violations": 1}
+        if m.get("forked_qc"):
+            mc["forked_qc"] = 1
+        if m.get("conflict_commits"):
+            mc["conflict_commits"] = 1
+        b["min_counters"] = mc
+        if avail >= 0.99:
+            # A SILENT safety finding: the lane never dipped, so the
+            # scenario's claim is "liveness looks healthy while the
+            # invariant breaks" — asserting an availability dip would
+            # contradict the finding itself.
+            del b["max_availability"]
     return b
 
 
@@ -676,6 +821,8 @@ _TUNED_FIELDS = {
     "paxos": ("n_nodes", "n_rounds", "log_capacity"),
     "dpos": ("n_nodes", "n_rounds", "log_capacity", "n_candidates",
              "n_producers"),
+    "hotstuff": ("n_nodes", "f", "n_rounds", "log_capacity",
+                 "view_timeout"),
 }
 
 
@@ -723,6 +870,25 @@ def distill(st: SearchState, finding_index: int, name: str,
         overrides["max_delay_rounds"] = base.max_delay_rounds
     if base.max_crashed and "crash_prob" in overrides:
         overrides["max_crashed"] = base.max_crashed
+    # SPEC §9/§9b/§6 statics: the switch topology, the byzantine census
+    # and the fault granularity shape the attack but are not searchable
+    # rates — a distilled scenario must carry them or its replay runs a
+    # different fabric than the finding's lane.
+    if base.net_model == "switch":
+        overrides["net_model"] = "switch"
+        overrides["n_aggregators"] = base.n_aggregators
+        if base.agg_byz:
+            overrides["agg_byz"] = base.agg_byz
+        for k in ("agg_fail_rate", "agg_stale_rate"):
+            if getattr(base, k) > 0:
+                overrides[k] = getattr(base, k)
+        if base.agg_stale_rate > 0:
+            overrides["agg_max_stale"] = base.agg_max_stale
+    if base.n_byzantine:
+        overrides["n_byzantine"] = base.n_byzantine
+        overrides["byz_mode"] = base.byz_mode
+    if base.fault_model != "edge":
+        overrides["fault_model"] = base.fault_model
     for k in RATE_CUTOFFS:
         if k == "attack_rate" and base.attack == "none":
             continue  # a bare attack_rate is rejected by Config
@@ -778,6 +944,65 @@ def distill(st: SearchState, finding_index: int, name: str,
             "entering the catalog")
     entry["scenario"]["verified_availability"] = verdict["availability"]
     return entry
+
+
+def promote(name: str, catalog_path, *, seeds: tuple[int, ...],
+            n_sweeps: int = 2, log=None) -> dict:
+    """The auto-promotion gate between 'distilled' and 'CI tripwire':
+    re-run catalog entry ``name`` at its tuned shape across K FRESH
+    seeds and admit it to the ``make check`` scenario smokes (the
+    entry gains a ``promoted`` record tools/check.py reads) only when
+    the TimelineBounds hold on EVERY seed. Distillation verifies one
+    fresh run; promotion is the stability bar — a scenario that gates
+    CI must not be a single-seed fluke. Any failing seed raises (with
+    the failed checks) and leaves the catalog untouched."""
+    import dataclasses as _dc
+
+    from consensus_tpu import scenarios as scen
+    from consensus_tpu.network import simulator
+
+    log = log or (lambda *_: None)
+    if not seeds:
+        raise ValueError("promote needs at least one fresh seed")
+    p = pathlib.Path(catalog_path)
+    doc = json.loads(p.read_text())
+    by_name = {e["scenario"]["name"]: e for e in doc.get("scenarios", [])}
+    if name not in by_name:
+        raise ValueError(f"no catalog entry {name!r} in {p} "
+                         f"(known: {sorted(by_name)})")
+    entry = by_name[name]
+    sd = entry["scenario"]
+    s = scen.Scenario(
+        name=sd["name"], description=sd["description"],
+        protocol=sd["protocol"], overrides=dict(sd["overrides"]),
+        bounds=scen.TimelineBounds(**sd["bounds"]),
+        window=int(sd["window"]), min_rounds=int(sd["min_rounds"]),
+        tuned=dict(sd["tuned"]))
+    runs = []
+    for seed in seeds:
+        shape = _dc.replace(
+            Config(protocol=s.protocol, engine="tpu", **dict(s.tuned)),
+            n_sweeps=n_sweeps, seed=int(seed))
+        res = simulator.run(scen.apply(shape, s), warmup=False,
+                            telemetry=True, stats={})
+        verdict = scen.evaluate(s, res)
+        runs.append({"seed": int(seed), "passed": verdict["passed"],
+                     "availability": verdict["availability"]})
+        log(f"seed {seed}: {'PASS' if verdict['passed'] else 'FAIL'} "
+            f"(availability {verdict['availability']:.3f})")
+        if not verdict["passed"]:
+            bad = {k: c for k, c in verdict["checks"].items()
+                   if not c["ok"]}
+            raise ValueError(
+                f"scenario {name!r} FAILED its bounds at fresh seed "
+                f"{seed}: {bad} — not promoting (the catalog entry is "
+                "unchanged; it stays distilled-but-not-CI-gating)")
+    sd["promoted"] = {"seeds": [int(x) for x in seeds],
+                      "n_sweeps": n_sweeps, "runs": runs}
+    tmp = p.with_suffix(".tmp.json")
+    tmp.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    tmp.replace(p)
+    return sd["promoted"]
 
 
 def write_catalog(entry: dict, catalog_path) -> None:

@@ -149,12 +149,51 @@ SWITCH_SMOKE = ["-m", "consensus_tpu", "--scenario",
                 "--sweeps", "2", "--seed", "11", "--platform", "cpu"]
 
 
+# tuned-shape Config field -> CLI flag, for building promoted-scenario
+# smokes out of the discovered catalog (same flag names _FLAG_FIELDS in
+# consensus_tpu/cli.py declares; stdlib-only here by design).
+_TUNED_FLAGS = {"n_nodes": "--nodes", "f": "--f", "n_rounds": "--rounds",
+                "log_capacity": "--log-capacity",
+                "max_entries": "--max-entries",
+                "view_timeout": "--view-timeout",
+                "n_candidates": "--candidates",
+                "n_producers": "--producers"}
+
+
+def promoted_scenario_smokes() -> list[list[str]]:
+    """One CLI smoke per PROMOTED discovered scenario: catalog entries
+    that passed `python -m tools.advsearch promote` (bounds held across
+    K fresh seeds at the tuned shape) gate `make check` exactly like
+    the hand-built smokes above; distilled-but-unpromoted entries stay
+    runnable but do not gate CI."""
+    import json
+    path = os.path.join(REPO, "consensus_tpu", "scenarios",
+                        "discovered.json")
+    if not os.path.exists(path):
+        return []
+    doc = json.load(open(path))
+    smokes = []
+    for entry in doc.get("scenarios", []):
+        s = entry["scenario"]
+        if not s.get("promoted"):
+            continue
+        cmd = ["-m", "consensus_tpu", "--scenario", s["name"],
+               "--protocol", s["protocol"]]
+        for field, val in sorted(s["tuned"].items()):
+            cmd += [_TUNED_FLAGS[field], str(val)]
+        cmd += ["--sweeps", "2", "--seed",
+                str(s["promoted"]["seeds"][0]), "--platform", "cpu"]
+        smokes.append(cmd)
+    return smokes
+
+
 def layer_scenarios(_: argparse.Namespace) -> str:
     import importlib.util
     if importlib.util.find_spec("jax") is None:
         return "SKIP (jax not installed)"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    for smoke in (SCENARIO_SMOKE, HOTSTUFF_SMOKE, SWITCH_SMOKE):
+    for smoke in (SCENARIO_SMOKE, HOTSTUFF_SMOKE, SWITCH_SMOKE,
+                  *promoted_scenario_smokes()):
         if _run([sys.executable] + smoke, env=env):
             return "FAIL"
     return "ok"

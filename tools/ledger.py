@@ -58,7 +58,11 @@ NOISE_BAND = 0.15
 ROW_FIELDS = ("source", "kind", "name", "seq", "timestamp", "platform",
               "engine", "steps_per_sec", "wall_s", "steps", "digest",
               "stale", "predicted_steps_per_sec", "measured_vs_predicted",
-              "hbm_peak_frac_floor", "ok", "notes")
+              "hbm_peak_frac_floor", "ok", "notes",
+              # adv-search budget rows only (null on every other kind):
+              # generation-loop and candidate-evaluation totals for one
+              # search (tools/advsearch `budget` artifact).
+              "generations", "evals")
 
 # RESULTS row name -> cost-card name where they differ (the padded
 # one-program f-ladder row is costed by the fsweep card).
@@ -272,6 +276,38 @@ def service_rows(repo: pathlib.Path, cards: dict[str, dict]) -> list[dict]:
     return out
 
 
+def search_rows(repo: pathlib.Path) -> list[dict]:
+    """Rows from the committed adversary-search budget artifact
+    (``benchmarks/parts/search_budgets.json``, folded from per-search
+    ``search_budget.json`` sidecars by ``python -m tools.advsearch
+    budget``). One row per (space, search seed): how many generations
+    and candidate evaluations the search spent, for how much wall, and
+    what it bought (findings / coverage cells, in ``notes``). Search
+    cost has no steps/s series — the rows are a spend ledger, not a
+    throughput series, so they never drive a regression verdict."""
+    path = repo / "benchmarks" / "parts" / "search_budgets.json"
+    if not path.exists():
+        return []
+    try:
+        doc = json.loads(path.read_text())
+    except ValueError:
+        return []
+    out = []
+    for r in doc.get("rows", []):
+        out.append(_row(
+            source="benchmarks/parts/search_budgets.json",
+            kind="adv-search",
+            name=f"advsearch-{r.get('space', '?')}",
+            seq=r.get("search_seed"), engine="tpu",
+            wall_s=r.get("wall_s"),
+            generations=r.get("generations"), evals=r.get("evals"),
+            ok=bool(r.get("generations")),
+            notes=(f"population={r.get('population')}, "
+                   f"findings={r.get('findings')}, "
+                   f"coverage_cells={r.get('coverage_cells')}")))
+    return out
+
+
 def multichip_rows(repo: pathlib.Path) -> list[dict]:
     out = []
     for fname in sorted(glob.glob(str(repo / "MULTICHIP_r*.json"))):
@@ -367,7 +403,8 @@ def build_series(rows: list[dict]) -> dict[str, dict]:
 def build(repo: pathlib.Path) -> dict[str, Any]:
     cards = _load_cards(repo)
     rows = (bench_rows(repo, cards) + multichip_rows(repo)
-            + results_rows(repo, cards) + service_rows(repo, cards))
+            + results_rows(repo, cards) + service_rows(repo, cards)
+            + search_rows(repo))
     series = build_series(rows)
     regressions = sorted(k for k, s in series.items()
                          if s["verdict"] == "regression")

@@ -52,6 +52,10 @@ TELEMETRY_COUNTERS = frozenset({
     "crashes", "recoveries", "nodes_down",
     # in-network vote aggregation (SPEC §9, every switch-capable engine)
     "agg_down_rounds", "stale_serves",
+    # poisoned aggregation (SPEC §9b, pbft/hotstuff switch models)
+    "poisoned_serves",
+    # vote-certificate safety invariants (SPEC §7c, BFT engines)
+    "forked_qc", "conflict_commits", "safety_violations",
 })
 
 # Every flight-recorder protocol-latency histogram any engine may record
@@ -133,6 +137,8 @@ FINDING_FIELDS = frozenset({
 _FINDING_METRIC_KEYS = frozenset({
     "availability", "stall_windows", "stall_ratio", "fault_onset_window",
     "recovery_rounds", "never_recovered", "commit_rate", "lib_ratio",
+    # SPEC §7c safety-invariant totals (BFT vote engines only)
+    "forked_qc", "conflict_commits", "safety_violations",
 })
 
 # Cost-card top-level keys (tools/costmodel/model.py CARD_FIELDS —
@@ -161,9 +167,12 @@ LEDGER_ROW_FIELDS = frozenset({
     "steps_per_sec", "wall_s", "steps", "digest", "stale",
     "predicted_steps_per_sec", "measured_vs_predicted",
     "hbm_peak_frac_floor", "ok", "notes",
+    # adv-search budget rows only (null elsewhere): generation loop +
+    # candidate-evaluation totals for one search (tools/advsearch).
+    "generations", "evals",
 })
 _LEDGER_KINDS = frozenset({"results-tpu", "results-oracle", "driver-bench",
-                           "multichip-dryrun", "service-job"})
+                           "multichip-dryrun", "service-job", "adv-search"})
 
 # One sweep-service completed-job report row = exactly these keys
 # (consensus_tpu/service/jobs.py JOB_REPORT_FIELDS — lint-synced both
