@@ -46,6 +46,8 @@ _FLAG_FIELDS = {
     "agg_byz": ("agg_byz", 0),
     "agg_poison_rate": ("agg_poison_rate", 0.0),
     "byz_uplink_rate": ("byz_uplink_rate", 0.0),
+    "desync_rate": ("desync_rate", 0.0),
+    "max_skew_rounds": ("max_skew_rounds", 1),
     "attack": ("attack", "none"),
     "attack_rate": ("attack_rate", 1.0),
     "attack_target": ("attack_target", 0),
@@ -70,7 +72,7 @@ _FLAG_TYPES = {"protocol": str, "engine": str, "byz_mode": str,
                "attack": str, "attack_rate": float,
                "net_model": str, "agg_fail_rate": float,
                "agg_stale_rate": float, "agg_poison_rate": float,
-               "byz_uplink_rate": float}
+               "byz_uplink_rate": float, "desync_rate": float}
 
 # Config fields with NO native-CLI flag (cpp/consensus_sim.cpp): TPU-
 # engine execution/adversary knobs. The native front door still reaches

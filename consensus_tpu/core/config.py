@@ -117,6 +117,16 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     agg_poison_rate: float = 0.0
     byz_uplink_rate: float = 0.0
 
+    # SPEC §B per-node view-synchronizer timer skew (pbft, hotstuff —
+    # the per-node pacemakers; mirrored): each up node's local view
+    # timer jumps ahead by d in [1, max_skew_rounds] rounds with
+    # desync_rate per (round, node) (STREAM_DESYNC), firing premature
+    # local timeouts that desynchronize views — the PAPERS.md
+    # 2601.00273 timer-desync attack class. 0 = off (compiled no-op;
+    # the round program is byte-stable modulo these Config fields).
+    desync_rate: float = 0.0
+    max_skew_rounds: int = 1     # skew depth bound, in [1, 8]
+
     # SPEC §A.4 correlated DPoS producer suppression (dpos only;
     # mirrored): one draw per (round // suppress_window, producer), so
     # a suppressed producer misses EVERY slot inside the window — the
@@ -302,6 +312,20 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             raise ValueError("agg_max_stale must be in [1, 8] (SPEC §9: "
                              "the stale re-draw is a bounded shift, like "
                              "the §A.2 delay horizon)")
+        if self.desync_rate > 0 and self.protocol not in ("pbft",
+                                                          "hotstuff"):
+            raise ValueError(
+                "desync_rate is the SPEC §B view-synchronizer timer-skew "
+                f"adversary of the per-node BFT pacemakers; {self.protocol} "
+                "has no per-node view timer and would silently ignore it")
+        if not (1 <= self.max_skew_rounds <= 8):
+            raise ValueError("max_skew_rounds must be in [1, 8] (SPEC §B: "
+                             "the skew depth is a bounded jump, like the "
+                             "§9 stale horizon)")
+        if self.max_skew_rounds != 1 and self.desync_rate == 0:
+            raise ValueError(
+                "max_skew_rounds requires desync_rate > 0 (SPEC §B) "
+                "— it would be silently ignored")
         if self.suppress_rate > 0 and self.protocol != "dpos":
             raise ValueError(
                 "suppress_rate is the SPEC §A.4 correlated DPoS "
@@ -394,6 +418,10 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     def suppress_cutoff(self) -> int:
         return prob_threshold_u32(self.suppress_rate)
 
+    @property
+    def desync_cutoff(self) -> int:
+        return prob_threshold_u32(self.desync_rate)
+
     # Static adversary GATES — the Python-level on/off facts the engines
     # branch on while tracing (the cutoff VALUES only ever feed jnp
     # compares). Engines must read these instead of comparing cutoffs
@@ -441,6 +469,12 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
     def suppress_on(self) -> bool:
         return self.suppress_cutoff > 0
 
+    @property
+    def desync_on(self) -> bool:
+        """SPEC §B static gate: desync-free configs compile the skew-free
+        round program byte-for-byte."""
+        return self.desync_cutoff > 0
+
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
         d["mesh_shape"] = list(self.mesh_shape)
@@ -457,6 +491,7 @@ class Config:  # frozen ⇒ hashable ⇒ usable as a jit static argument
             "agg_poison": self.agg_poison_cutoff,
             "byz_uplink": self.byz_uplink_cutoff,
             "suppress": self.suppress_cutoff,
+            "desync": self.desync_cutoff,
         }
         return json.dumps(d, indent=2)
 

@@ -46,7 +46,11 @@ KNOB_COLUMNS = ("drop_cutoff", "partition_cutoff", "churn_cutoff",
                 # exactly like the delivery cutoffs. Their gates
                 # (agg_poison_on / uplink_lies_on) stay static on the
                 # base, per the gate/value split above.
-                "agg_poison_cutoff", "byz_uplink_cutoff")
+                "agg_poison_cutoff", "byz_uplink_cutoff",
+                # SPEC §B per-node view-synchronizer timer skew: feeds
+                # ops/viewsync's `_lt()` u32 compare; its gate
+                # (desync_on) stays static on the base.
+                "desync_cutoff")
 
 
 class KnobView:

@@ -86,6 +86,14 @@ STREAM_POISON = np.uint32(0x6A09E667)    # per (round, subdraw, vertex_or_node)
 # the targeted (correlated) stream RESILIENCE.md §8 records iid
 # slot-miss keying cannot emulate. dpos only; mirrored.
 STREAM_SUPPRESS = np.uint32(0x1F83D9AB)  # per (window, subdraw, producer)
+# SPEC §B per-node view-synchronizer timer skew: one activation draw and
+# one depth draw per (round, node) — c0 selects the subdraw: 0 = skew
+# activation (fires when the draw < desync_cutoff), 1 = the skew depth
+# d in [1, max_skew_rounds] added to the node's local view timer. BFT
+# engines only (pbft, hotstuff — the per-node pacemakers); a compiled
+# no-op at the desync_rate=0 default. Mirrored scalar-for-scalar in
+# cpp/oracle.cpp.
+STREAM_DESYNC = np.uint32(0x5BE0CD19)    # per (round, subdraw, node)
 # Host-side adversary-search orchestration (tools/advsearch): candidate
 # sampling, mutation and eval-seed draws. Never drawn on device or in
 # the oracle — registered so search runs replay exactly from one seed
@@ -120,6 +128,7 @@ STREAM_KEYS = {
     "STREAM_AGG": ("round", "subdraw", "aggregator"),  # c0: 0=fail 1=stale 2=depth
     "STREAM_POISON": ("round", "subdraw", "vertex_or_node"),  # c0: 0=serve 1=lie 2=val
     "STREAM_SUPPRESS": ("window", "subdraw", "producer"),  # c0: 0 (reserved)
+    "STREAM_DESYNC": ("round", "subdraw", "node"),  # c0: 0=activation 1=depth
     "STREAM_SEARCH": ("generation", "subdraw", "index"),
 }
 

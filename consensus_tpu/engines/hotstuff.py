@@ -26,26 +26,39 @@ the round leader. This engine is the array form of that move:
     every round forms a QC, so every round commits one block while the
     two newer blocks advance a phase — one block per round through a
     three-deep pipeline.
-  * **Pacemaker.** Views rotate leaders round-robin (leader(v) =
-    v mod N). The view advances on QC formation, or — view-change —
-    after ``view_timeout`` rounds without one (a crashed / churned /
-    partitioned-away / silent-byzantine leader). A failed view breaks
-    the consecutive-view chain, so its cost is visible as
+  * **Per-node view synchronizer (SPEC §B).** Since the view-desync
+    PR there is NO global pacemaker: every node keeps its own
+    (view, timer) pair, advanced by locally-observed QCs and LOCAL
+    timeouts, and views only ever re-align through delivered messages
+    — a highest-view gossip flight (P1) and the proposal/QC-notify
+    broadcast (P2/P6), all riding the same §2 delivery layer. Leaders
+    rotate round-robin per RECEIVER: node i expects leader
+    view[i] mod N, the round's effective proposer is the
+    highest-view node whose own view elects it, and a receiver
+    ignores proposals from views below its own. So drop, delay
+    (§A.2), partition, crash (§6c), switch faults (§9) and byzantine
+    senders naturally DESYNCHRONIZE views — the PAPERS.md 2601.00273
+    attack class — and the STREAM_DESYNC timer-skew axis
+    (ops/viewsync.desync_skew) injects it directly. A failed view
+    breaks the consecutive-view chain, so its cost is visible as
     chain-commit lag, exactly the liveness shape the literature's
     leader-rotation attacks target.
 
-State split: the pacemaker + QC-chain registers and the certified-view
-map are GLOBAL per sweep (the certified chain is the network's shared
-state; forks are unreachable in this model because a QC certifies one
-block per height and the next proposal extends the newest QC). The
-per-NODE state is what each replica has locally observed: its synced
-view, its progress timer, and its durable committed prefix — O(N)
-carry leaves, no [N, S] tensor anywhere.
+State split: the QC-chain registers and the certified-view map are
+GLOBAL per sweep (the certified chain is the network's shared state;
+forks are unreachable in this model because a QC certifies one block
+per height and the next proposal extends the newest QC). The per-NODE
+state is what each replica has locally observed: its own pacemaker
+(view, timer) and its durable committed prefix — O(N) carry leaves,
+no [N, S] tensor anywhere. At zero fault rates every node's view
+advances in lockstep, and the trajectory is bit-identical to the
+retired global pacemaker (kept as the reference twin,
+tests/reference_hotstuff.py — the PR 8 playbook).
 
 Scalar twin: ``cpp/oracle.cpp`` ``HotstuffSim`` (the PR 5
 aggregate-round pattern), byte-differential on decided logs across the
 full adversary surface (drop / partition / churn / §6c crash-recover /
-§A.2 delay) — tests/test_hotstuff.py.
+§A.2 delay / §B desync) — tests/test_hotstuff.py.
 """
 from __future__ import annotations
 
@@ -64,6 +77,7 @@ from ..ops.aggregate import (AGG_TELEMETRY, agg_counts, agg_ids, agg_poison,
                              agg_round, downlink, poison_count, seg_sum,
                              seg_widths, take_seg, uplink_edge, uplink_lies)
 from ..ops.flight import bucket_counts
+from ..ops.viewsync import SYNC_TELEMETRY, desync_skew, sync_counts
 
 # SPEC §7c fork-certificate table depth: at most this many FORKED QCs
 # (two conflicting quorums in one view) are value-tracked per run; later
@@ -75,8 +89,6 @@ FORK_TABLE = 8
 
 class HotstuffState(NamedTuple):
     seed: jnp.ndarray       # [] uint32
-    gview: jnp.ndarray      # [] i32 — pacemaker view (global per sweep)
-    gtimer: jnp.ndarray     # [] i32 — rounds spent in the current view
     b1_v: jnp.ndarray       # [] i32 — newest QC: view (-1 = none)
     b1_h: jnp.ndarray       # [] i32 — newest QC: height (-1 = none)
     b2_v: jnp.ndarray       # [] i32 — parent QC (the locked block)
@@ -90,7 +102,7 @@ class HotstuffState(NamedTuple):
     ftab_v: jnp.ndarray     # [FORK_TABLE] i32 — fork entry: certifying view
     ftab_h: jnp.ndarray     # [FORK_TABLE] i32 — fork entry: height
     fnum: jnp.ndarray       # [] i32 — fork entries recorded (<= FORK_TABLE)
-    view: jnp.ndarray       # [N] i32 — last view node i synced to
+    view: jnp.ndarray       # [N] i32 — node i's OWN pacemaker view (§B)
     timer: jnp.ndarray      # [N] i32 — rounds since node i saw progress
     clen: jnp.ndarray       # [N] i32 — committed length node i learned
     down: jnp.ndarray       # [N] bool — SPEC §6c crashed mask
@@ -109,16 +121,14 @@ PROGRAM_CONTRACT = dict(sort_budget=0, cumsum_budget=0,
 
 # SPEC §6c persistent/volatile carry split (tools/lint check
 # `registry`): a replica's committed prefix (`clen`) is the durable
-# state HotStuff's safety argument rests on; pacemaker sync (`view`,
-# `timer`) is volatile — a recovering node rejoins at view 0 and
-# resyncs from the next delivered proposal. The global pacemaker / QC
-# chain / certified-view map are the NETWORK's abstract state (like the
-# dpos producer schedule), not any node's — "meta", untouched by
+# state HotStuff's safety argument rests on; its own pacemaker
+# (`view`, `timer`) is volatile — a recovering node rejoins at view 0
+# and resyncs from the next delivered gossip/proposal (§B). The QC
+# chain / certified-view map are the NETWORK's abstract state (like
+# the dpos producer schedule), not any node's — "meta", untouched by
 # crashes.
 CRASH_SPLIT = {
     "seed": "meta",
-    "gview": "meta",
-    "gtimer": "meta",
     "b1_v": "meta",
     "b1_h": "meta",
     "b2_v": "meta",
@@ -142,23 +152,27 @@ CRASH_SPLIT = {
     "down": "meta",
 }
 
-# On-device protocol telemetry (docs/OBSERVABILITY.md).
+# On-device protocol telemetry (docs/OBSERVABILITY.md). view_changes
+# counts PER-NODE timeout-driven view advances since the §B per-node
+# pacemaker (a synchronized population times out N-at-a-time).
 HOTSTUFF_TELEMETRY = ("qc_formed",            # rounds forming a QC (0/1)
                       "blocks_committed",     # global commit advance
                       "commits_learned",      # Σ per-node clen advance
-                      "view_changes",         # timeout-driven advances
+                      "view_changes",         # Σ per-node timeout advances
                       "proposals_delivered",  # Σ receivers of the round
                       "votes_counted",        # votes the leader counted
                       ) + CRASH_TELEMETRY \
                       + AGG_TELEMETRY \
-                      + SAFETY_TELEMETRY      # SPEC §7c/§9 (zeros unless
-                      #                         equivocate / poisoned)
+                      + SAFETY_TELEMETRY \
+                      + SYNC_TELEMETRY        # SPEC §7c/§9/§B (zeros
+                      #                         unless the axes are on /
+                      #                         views actually drift)
 
 # Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
 # recorder"):
-#   view_change_wait_rounds — at each view advance (QC or timeout), the
-#     rounds the view took (gtimer + 1): 1 in the fault-free steady
-#     state, view_timeout under a dead leader.
+#   view_change_wait_rounds — at each node's view advance (QC learned
+#     or local timeout), the rounds ITS view took (timer + 1): 1 in
+#     the fault-free steady state, view_timeout under a dead leader.
 #   chain_commit_lag_rounds — per round, the pipeline depth
 #     head_height - gcommit: the chained prepare/pre-commit stages not
 #     yet committed (2-3 steady state; grows when failed views break
@@ -200,59 +214,105 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
         view = jnp.where(rec, 0, view)
         timer = jnp.where(rec, 0, timer)
         frozen = (view, timer, clen)
+    # SPEC §B timer-skew injection: an affected node's local timer
+    # jumps ahead, and when the skewed timer crosses view_timeout the
+    # node times out RIGHT HERE — abandoning its view before this
+    # round's proposal even arrives (the 2601.00273 premature-timeout
+    # attack; P7's end-of-round check can't express that, since any
+    # delivered proposal would reset the timer first). Applied AFTER
+    # the frozen capture so the end-of-round freeze discards a down
+    # node's skew — the oracle's `!is_down(i)` guard.
+    if cfg.desync_on:
+        timer = timer + desync_skew(seed, ur, uidx, cfg.desync_cutoff,
+                                    cfg.max_skew_rounds)
+        pre_to = timer >= cfg.view_timeout
+        view = view + pre_to.astype(jnp.int32)
+        timer = jnp.where(pre_to, 0, timer)
 
     # ---- P0 churn: the round's leader is offline (SPEC §2 "all
-    # leaders step down" — in a one-leader-per-view protocol, the view's
-    # leader skips its slot, forcing the pacemaker's timeout path).
+    # leaders step down" — in a one-leader-per-view protocol, every
+    # would-be proposer skips its slot, forcing the timeout path).
     churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
 
-    # ---- P1 proposal: leader(gview) extends the newest QC with the
-    # block at height b1_h + 1; the broadcast is ONE leader→node
-    # delivery row on absolute §2 edge keys (the dpos producer-row
-    # idiom — O(N), never [N, N]).
-    L = st.gview % jnp.int32(N)
-    uL = L.astype(jnp.uint32)
     honest = idx < (N - cfg.n_byzantine)   # SPEC §3c-style silent byz
+    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
+    part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
+                   < _lt(cfg.partition_cutoff))
+    side = _draw(seed, rng.STREAM_PARTITION, ur, 1, uidx) & jnp.uint32(1)
+
+    def _bcast_open(src_u32):
+        """§2 openness of the src→j broadcast row on absolute edge
+        keys (+ §A.2 retransmission). Delivery is per (round, edge):
+        two flights sharing an edge in one round share its fate, so
+        the gossip and proposal rows from one sender draw the SAME
+        words — the model's link-state semantics, not a collision."""
+        o = ~(rng.delivery_u32_jnp(seed, ur, src_u32, uidx)
+              < _lt(cfg.drop_cutoff))
+        if cfg.max_delay_rounds > 0:
+            o |= delayed_open(seed, ur, src_u32, uidx, cfg.drop_cutoff,
+                              cfg.max_delay_rounds)
+        side_s = _draw(seed, rng.STREAM_PARTITION, ur, 1, src_u32) \
+            & jnp.uint32(1)
+        return o & ((side == side_s) | ~part_active)
+
+    # ---- P1 highest-view gossip (SPEC §B view-sync message): the
+    # highest-view honest live node broadcasts its view (lowest id on
+    # ties — deterministic, mirrored); receivers behind it catch up.
+    # This is the synchronizer's re-alignment channel — ONE O(N)
+    # broadcast row through the §2 delivery layer, so drops/partitions/
+    # crashes bound how fast desynced views can heal. Fault-free it is
+    # a compiled-identical no-op on the trajectory (no view is ever
+    # behind), preserving the global-pacemaker bit-identity.
+    alive_h = honest & ~down if crash_on else honest
+    vM = jnp.max(jnp.where(alive_h, view, -1))
+    M = jnp.min(jnp.where(alive_h & (view == vM), idx, N))
+    uM = jnp.clip(M, 0, N - 1).astype(jnp.uint32)
+    gdel = ((vM >= 0) & (idx != M) & _bcast_open(uM))
+    if crash_on:
+        gdel &= ~down
+    adv_g = gdel & (view < vM)
+    view = jnp.where(adv_g, vM, view)
+
+    # ---- P2 proposal: node i proposes iff ITS view elects it
+    # (view[i] mod N == i — the §B per-receiver leader identity) and
+    # extends the newest QC with the block at height b1_h + 1. With
+    # desynced views several nodes may propose at once; the round's
+    # EFFECTIVE proposal is the highest-view one (Vstar — stale
+    # proposals lose, and a receiver ignores views below its own).
+    # The broadcast is ONE leader→node delivery row on absolute §2
+    # edge keys (the dpos producer-row idiom — O(N), never [N, N]).
     h_next = st.b1_h + 1
     # SPEC §7c: under byz_mode="equivocate" a byzantine leader DOES
     # propose — two block variants for the same (view, height), each
     # receiver shown one (per-receiver value-id e_j below). Under the
-    # default silent mode a byzantine leader skips its view, exactly as
-    # before (`equiv` is a Python bool: the flat/silent program is
+    # default silent mode a byzantine leader skips its view, exactly
+    # as before (`equiv` is a Python bool: the flat/silent program is
     # unchanged bit for bit).
-    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
-    byzL = L >= jnp.int32(N - cfg.n_byzantine)
-    if equiv:
-        proposing = ~churn & (h_next < S)
-    else:
-        proposing = ~churn & ~byzL & (h_next < S)
+    prop_i = (view % jnp.int32(N) == idx) & ~churn & (h_next < S)
+    if not equiv:
+        prop_i &= honest
     if crash_on:
-        proposing &= ~down[L]
+        prop_i &= ~down
+    Vstar = jnp.max(jnp.where(prop_i, view, -1))
+    exists = Vstar >= 0
+    L = jnp.where(exists, Vstar % jnp.int32(N), jnp.int32(0))
+    uL = L.astype(jnp.uint32)
+    byzL = L >= jnp.int32(N - cfg.n_byzantine)
 
     switch = cfg.switch_on
-    open_p = ~(rng.delivery_u32_jnp(seed, ur, uL, uidx)
-               < _lt(cfg.drop_cutoff))
-    if cfg.max_delay_rounds > 0:
-        # SPEC §A.2 delayed retransmission, on the same absolute keys.
-        open_p |= delayed_open(seed, ur, uL, uidx, cfg.drop_cutoff,
-                               cfg.max_delay_rounds)
+    open_p = _bcast_open(uL)
     if not switch:
         open_v = ~(rng.delivery_u32_jnp(seed, ur, uidx, uL)
                    < _lt(cfg.drop_cutoff))
         if cfg.max_delay_rounds > 0:
             open_v |= delayed_open(seed, ur, uidx, uL, cfg.drop_cutoff,
                                    cfg.max_delay_rounds)
-    part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
-                   < _lt(cfg.partition_cutoff))
-    side = _draw(seed, rng.STREAM_PARTITION, ur, 1, uidx) & jnp.uint32(1)
-    side_L = _draw(seed, rng.STREAM_PARTITION, ur, 1, uL) & jnp.uint32(1)
-    same_side = (side == side_L) | ~part_active
 
-    pdel = proposing & ((idx == L) | (open_p & same_side))
+    pdel = exists & ((idx == L) | open_p) & (view <= Vstar)
     if crash_on:
         pdel &= ~down   # down receivers hear nothing (SPEC §6c)
 
-    # ---- P2 votes: receivers of the proposal vote; the vote is a
+    # ---- P3 votes: receivers of the proposal vote; the vote is a
     # node→leader flight on edge (j, L). Byzantine replicas (silent)
     # withhold. The leader's threshold check is ONE count — the whole
     # linear-communication point. (Given pdel, the partition side check
@@ -347,27 +407,27 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
         # this byzantine model deliberately re-admits. The canonical
         # chain prefers variant 0 (deterministic tie-break, mirrored
         # in the oracle).
-        qc0 = proposing & (cnt0 >= Q)
-        qc1 = proposing & (cnt1 >= Q)
+        qc0 = exists & (cnt0 >= Q)
+        qc1 = exists & (cnt1 >= Q)
         qc = qc0 | qc1
         forked = qc0 & qc1
         vid = jnp.where(qc0, jnp.int32(0), jnp.int32(1))
         cnt = cnt0 + cnt1   # telemetry: total votes the leader counted
     else:
-        qc = proposing & (cnt >= Q)
+        qc = exists & (cnt >= Q)
 
-    # ---- P3 QC-chain shift + chained 3-chain commit: the new QC is
+    # ---- P4 QC-chain shift + chained 3-chain commit: the new QC is
     # the prepare phase of its block, promotes its parent to
     # pre-commit (the lock) and — when the three newest QCs sit in
     # consecutive views — commits the grandparent.
-    b1_v = jnp.where(qc, st.gview, st.b1_v)
+    b1_v = jnp.where(qc, Vstar, st.b1_v)
     b1_h = jnp.where(qc, h_next, st.b1_h)
     b2_v = jnp.where(qc, st.b1_v, st.b2_v)
     b2_h = jnp.where(qc, st.b1_h, st.b2_h)
     b3_v = jnp.where(qc, st.b2_v, st.b3_v)
     b3_h = jnp.where(qc, st.b2_h, st.b3_h)
     sarange = jnp.arange(S, dtype=jnp.int32)
-    chain_v = jnp.where((sarange == h_next) & qc, st.gview, st.chain_v)
+    chain_v = jnp.where((sarange == h_next) & qc, Vstar, st.chain_v)
     consec = (b3_v >= 0) & (b1_v == b2_v + 1) & (b2_v == b3_v + 1)
     gcommit = jnp.where(qc & consec,
                         jnp.maximum(st.gcommit, b3_h + 1), st.gcommit)
@@ -383,7 +443,7 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
         deceived = pdel & honest & (evid == 1)
         can = forked & (st.fnum < FORK_TABLE)
         hot = (jnp.arange(FORK_TABLE, dtype=jnp.int32) == st.fnum) & can
-        ftab_v = jnp.where(hot, st.gview, st.ftab_v)
+        ftab_v = jnp.where(hot, Vstar, st.ftab_v)
         ftab_h = jnp.where(hot, h_next, st.ftab_h)
         fbit = jnp.left_shift(jnp.int32(1),
                               jnp.minimum(st.fnum, FORK_TABLE - 1))
@@ -393,20 +453,25 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
         chain_vid, fvec = st.chain_vid, st.fvec
         ftab_v, ftab_h, fnum = st.ftab_v, st.ftab_h, st.fnum
 
-    # ---- P4 learning: the proposal carries the pacemaker view and the
-    # commit state as of proposal time, so every receiver syncs its
-    # view, resets its progress timer, and extends its durable
-    # committed prefix to the start-of-round global commit.
-    view = jnp.where(pdel, st.gview, view)
+    # ---- P6 learning + QC-notify: the proposal carries the proposer's
+    # view and the commit state as of proposal time, so every receiver
+    # syncs to Vstar and extends its durable committed prefix; when the
+    # QC forms, the same open channels carry the certificate back out,
+    # so receivers enter view Vstar + 1 — the within-round notify the
+    # chained pipeline needs (without it the 3-chain's consecutive-view
+    # rule could never fire).
+    view = jnp.where(pdel, jnp.where(qc, Vstar + 1, Vstar), view)
     clen = jnp.where(pdel, jnp.maximum(clen, st.gcommit), clen)
-    timer = jnp.where(pdel, 0, timer + 1)
 
-    # ---- P5 pacemaker: QC advances the view; otherwise the view
-    # changes after view_timeout rounds without one.
-    to = ~qc & (st.gtimer + 1 >= cfg.view_timeout)
-    adv = qc | to
-    gview = st.gview + adv.astype(jnp.int32)
-    gtimer = jnp.where(adv, 0, st.gtimer + 1)
+    # ---- P7 per-node pacemaker: progress (a delivered proposal or a
+    # view-sync catch-up) resets the local timer; otherwise the node's
+    # OWN view changes after view_timeout local rounds without it.
+    progress = pdel | adv_g
+    to = ~progress & (timer + 1 >= cfg.view_timeout)
+    advn = (pdel & qc) | adv_g | to       # node's view advanced this round
+    view = view + to.astype(jnp.int32)
+    timer_pre = timer                     # flight: rounds this view took
+    timer = jnp.where(progress | to, 0, timer + 1)
 
     if crash_on:
         # SPEC §6c freeze: a down node's local state holds its
@@ -414,7 +479,7 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
         # prefix must not grow, while crashed).
         view, timer, clen = freeze_down(down, frozen, (view, timer, clen))
 
-    new = HotstuffState(seed, gview, gtimer, b1_v, b1_h, b2_v, b2_h,
+    new = HotstuffState(seed, b1_v, b1_h, b2_v, b2_h,
                         b3_v, b3_h, gcommit, chain_v, chain_vid, fvec,
                         ftab_v, ftab_h, fnum, view, timer, clen, down)
     if not telem:
@@ -436,16 +501,20 @@ def hotstuff_round(cfg: Config, st: HotstuffState, r, *,
         sz = safety_counts(forked, conf)
     else:
         sz = safety_counts()
+    syncz = sync_counts(new.view, honest & ~new.down, adv_g)
+    tosum = jnp.sum(to.astype(jnp.int32))
+    if cfg.desync_on:
+        tosum = tosum + jnp.sum(pre_to.astype(jnp.int32))
     vec = jnp.stack([qc.astype(jnp.int32),
                      gcommit - st.gcommit,
                      jnp.sum(new.clen - st.clen),
-                     to.astype(jnp.int32),
+                     tosum,
                      jnp.sum(pdel.astype(jnp.int32)),
-                     cnt, *cz, *az, *sz])
+                     cnt, *cz, *az, *sz, *syncz])
     if not flight:
         return new, vec
     lat = jnp.stack([
-        bucket_counts(st.gtimer + 1, adv),
+        bucket_counts(timer_pre + 1, advn),
         bucket_counts(b1_h + 1 - gcommit, True)])
     return new, vec, lat
 
@@ -455,7 +524,7 @@ def hotstuff_init(cfg: Config, seed) -> HotstuffState:
     z = jnp.int32(0)
     none = jnp.int32(-1)
     return HotstuffState(
-        jnp.asarray(seed, jnp.uint32), z, z, none, none, none, none,
+        jnp.asarray(seed, jnp.uint32), none, none, none, none,
         none, none, z, jnp.full((S,), -1, jnp.int32),
         jnp.zeros(S, jnp.int32), jnp.zeros(N, jnp.int32),
         jnp.full((FORK_TABLE,), -1, jnp.int32),
@@ -508,7 +577,7 @@ def _pspec(cfg: Config) -> HotstuffState:
     from jax.sharding import PartitionSpec as P
     from ..parallel.mesh import NODE_AXIS as ND
     g, v = P(), P(ND)
-    return HotstuffState(seed=g, gview=g, gtimer=g, b1_v=g, b1_h=g,
+    return HotstuffState(seed=g, b1_v=g, b1_h=g,
                          b2_v=g, b2_h=g, b3_v=g, b3_h=g, gcommit=g,
                          chain_v=P(None), chain_vid=P(None), fvec=v,
                          ftab_v=P(None), ftab_h=P(None), fnum=g,

@@ -23,6 +23,7 @@ from ..core.config import Config
 from ..ops.adversary import (CRASH_TELEMETRY, SAFETY_TELEMETRY, crash_counts,
                              crash_transition, freeze_down, safety_counts)
 from ..ops.aggregate import AGG_TELEMETRY, agg_counts, poison_count
+from ..ops.viewsync import SYNC_TELEMETRY, desync_skew, sync_counts
 from .raft import _delivery, _draw, _i32, _lt
 
 
@@ -128,7 +129,8 @@ PBFT_TELEMETRY = ("prepare_quorums",   # (node, slot) newly prepared
                   "view_changes",      # Σ per-node view advance
                   ) + CRASH_TELEMETRY \
                   + AGG_TELEMETRY \
-                  + SAFETY_TELEMETRY   # SPEC §7c (zeros when byz off)
+                  + SAFETY_TELEMETRY \
+                  + SYNC_TELEMETRY     # SPEC §B view-desync gauges
 
 # Flight-recorder latency histograms (docs/OBSERVABILITY.md §"Flight
 # recorder"; shared with the §6b bcast kernel):
@@ -194,6 +196,15 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         frozen = (view, timer, pp_seen, pp_view, pp_val, prepared,
                   committed, dval)
     committed_at_start = committed
+    # SPEC §B timer-skew injection: an affected node's local timer jumps
+    # ahead, so P2's start-of-round timeout fires before this round's
+    # pre-prepare can reset it — the premature local view change of the
+    # 2601.00273 attack class. Applied AFTER the frozen capture so the
+    # §6c freeze discards a down node's skew (the oracle's `!is_down`
+    # guard); a compiled no-op at the desync_rate=0 default.
+    if cfg.desync_on:
+        timer = timer + desync_skew(seed, ur, idx.astype(jnp.uint32),
+                                    cfg.desync_cutoff, cfg.max_skew_rounds)
 
     # ---- P0 churn: synchronized view bump.
     view = view + churn.astype(jnp.int32)
@@ -393,10 +404,14 @@ def pbft_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         sz = safety_counts(forked, conflicts)
     else:
         sz = safety_counts()
+    # SPEC §B desync gauges: end-of-round view disagreement among the
+    # honest live population, plus the P1 catch-ups that healed some of
+    # it — pbft's view-sync message is the f+1 catch-up rule.
+    syncz = sync_counts(view, honest & ~down, catch)
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
                      jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az,
-                     *sz])
+                     *sz, *syncz])
     if not flight:
         return new, vec
     from ..ops.flight import bucket_counts

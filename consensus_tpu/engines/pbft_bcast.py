@@ -58,6 +58,7 @@ from ..ops.adversary import draw as _draw
 from ..ops.adversary import cutoff as _lt
 from ..ops.adversary import bitcast_i32 as _i32
 from ..ops.flight import bucket_counts
+from ..ops.viewsync import desync_skew, sync_counts
 from .pbft import PBFT_LATENCY, PBFT_TELEMETRY, PbftState, pbft_init
 
 I32_MAX = jnp.iinfo(jnp.int32).max
@@ -443,6 +444,13 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         frozen = (view, timer, pp_seen, pp_view, pp_val, prepared,
                   committed, dval)
     committed_at_start = committed
+    # SPEC §B timer-skew injection (same placement as the dense §6
+    # kernel): the skewed timer crosses P2's start-of-round timeout
+    # before any pre-prepare can reset it. After the frozen capture, so
+    # the §6c freeze discards a down node's skew; no-op at rate 0.
+    if cfg.desync_on:
+        timer = timer + desync_skew(seed, ur, uidx, cfg.desync_cutoff,
+                                    cfg.max_skew_rounds)
 
     # ---- P0 churn.
     view = view + churn.astype(jnp.int32)
@@ -702,10 +710,13 @@ def pbft_bcast_round(cfg: Config, st: PbftState, r, *, telem: bool = False,
         sz = safety_counts()
     # view_changes clips at 0 like the dense kernel: a §6c recovery
     # resets the view, and the raw delta would cancel real advances.
+    # SPEC §B desync gauges — same reductions as the dense kernel: P1
+    # catch-up is pbft's view-sync message.
+    syncz = sync_counts(view, honest & ~down, catch)
     vec = jnp.stack([cnt(prep_new), cnt(prep_miss), cnt(commit_now),
                      cnt(commit_miss), cnt(adopt),
                      jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az,
-                     *sz])
+                     *sz, *syncz])
     if not flight:
         return new, vec
     # Same PBFT_LATENCY semantics as the dense §6 kernel (the fault

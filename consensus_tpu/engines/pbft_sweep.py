@@ -52,6 +52,7 @@ from ..ops.adversary import delivery as _delivery
 from .pbft import _adopt_val, _vth_select
 from ..ops.adversary import draw as _draw
 from ..ops.adversary import bitcast_i32 as _i32
+from ..ops.viewsync import desync_skew
 from .pbft import PbftState
 from .pbft_bcast import (_aggregate_tallies, _kth_largest, _table_width,
                          view_bound)
@@ -171,6 +172,14 @@ def pbft_round_padded(cfg: Config, st: PbftState, r, n_real, f):
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
     prepared, committed, dval = st.prepared, st.committed, st.dval
     committed_at_start = committed
+    # SPEC §B timer-skew injection on ABSOLUTE node-id keys: real ids
+    # 0..n_real-1 draw exactly what a standalone 3f+1 run draws, so the
+    # padding stays byte-invisible; padded ids burn draws no real node
+    # ever observes. (No `real` mask needed — a padded node's timer is
+    # already dead state.)
+    if cfg.desync_on:
+        timer = timer + desync_skew(seed, ur, idx.astype(jnp.uint32),
+                                    cfg.desync_cutoff, cfg.max_skew_rounds)
 
     # ---- P0 churn: synchronized view bump.
     view = view + churn.astype(jnp.int32)
@@ -344,6 +353,11 @@ def pbft_bcast_round_padded(cfg: Config, st: PbftState, r, n_real, f,
     pp_seen, pp_view, pp_val = st.pp_seen, st.pp_view, st.pp_val
     prepared, committed, dval = st.prepared, st.committed, st.dval
     committed_at_start = committed
+    # SPEC §B timer-skew injection — same absolute-id keying as the
+    # dense padded round above.
+    if cfg.desync_on:
+        timer = timer + desync_skew(seed, ur, idx.astype(jnp.uint32),
+                                    cfg.desync_cutoff, cfg.max_skew_rounds)
 
     # ---- P0 churn.
     view = view + churn.astype(jnp.int32)

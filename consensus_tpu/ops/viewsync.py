@@ -1,0 +1,70 @@
+"""SPEC §B per-node view-synchronizer ops shared by the BFT engines.
+
+Since the per-node pacemaker PR, pbft, pbft_bcast, the padded f-ladder
+and hotstuff all advance *per-node* (view, timer) pairs — views only
+ever re-align through delivered messages (pbft's P1 view catch-up,
+hotstuff's highest-view gossip), so every §2 fault axis naturally
+desynchronizes them. This module holds the two pieces those engines
+share:
+
+  * the STREAM_DESYNC timer-skew adversary (:func:`desync_skew`) — the
+    direct injection knob for the PAPERS.md 2601.00273 attack class:
+    per (round, node), an up node's local timer jumps ahead by
+    d ∈ [1, max_skew_rounds] with desync_rate, firing premature local
+    timeouts. Keys are absolute node ids, so the padded f-ladder's
+    draws are byte-identical to the dedicated engines' (the padding
+    invisibility argument of engines/pbft_sweep.py). Mirrored
+    scalar-for-scalar in cpp/oracle.cpp.
+  * the desync telemetry tail (:data:`SYNC_TELEMETRY` /
+    :func:`sync_counts`) — how far apart the honest live views actually
+    drifted, and how much sync traffic got through to pull them back.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import rng
+from .adversary import cutoff, draw
+
+# SPEC §B desync telemetry tail shared by the BFT engines' counter
+# vectors (after the SAFETY tail): per-round gauges/counts that stay
+# meaningful under the flight recorder's window SUM — `view_spread_max`
+# sums per-round spreads (like nodes_down), `desync_rounds` counts
+# rounds with any disagreement, `sync_msgs_delivered` counts receivers
+# whose view advanced via a delivered view-sync message.
+SYNC_TELEMETRY = ("view_spread_max",      # Σ per-round max-min honest live view
+                  "desync_rounds",        # rounds with view disagreement
+                  "sync_msgs_delivered")  # receivers caught up via sync msgs
+
+
+def desync_skew(seed, r, ids, desync_cut: int, max_skew: int):
+    """SPEC §B: per-node timer skew for round r — 0 when the activation
+    draw misses, else the depth draw d ∈ [1, max_skew]. ``ids`` are
+    ABSOLUTE node ids (uint32), so padded-lane draws match the
+    dedicated engines byte-for-byte. Callers add the result to the
+    local timer BEFORE the round's timeout check and discard it for
+    down nodes (the oracle's ``!is_down`` guard / the §6c freeze).
+    Pure counter function — nothing rides the carry."""
+    ur = jnp.asarray(r, jnp.uint32)
+    ui = jnp.asarray(ids, jnp.uint32)
+    fire = draw(seed, rng.STREAM_DESYNC, ur, 0, ui) < cutoff(desync_cut)
+    depth = 1 + (draw(seed, rng.STREAM_DESYNC, ur, 1, ui)
+                 % jnp.uint32(max_skew)).astype(jnp.int32)
+    return jnp.where(fire, depth, 0)
+
+
+def sync_counts(view=None, mask=None, delivered=None):
+    """The :data:`SYNC_TELEMETRY` tail of an engine's counter vector —
+    call with no args for the pacemaker-free engines' zeros. ``view``
+    is the end-of-round per-node view, ``mask`` the honest-and-up
+    population whose disagreement counts (an empty mask reads as
+    spread 0), ``delivered`` the per-node caught-up-via-sync-message
+    flags this round."""
+    if view is None:
+        return (jnp.int32(0),) * 3
+    any_ = jnp.any(mask)
+    vmax = jnp.max(jnp.where(mask, view, jnp.iinfo(jnp.int32).min))
+    vmin = jnp.min(jnp.where(mask, view, jnp.iinfo(jnp.int32).max))
+    spread = jnp.where(any_, vmax - vmin, 0).astype(jnp.int32)
+    return (spread, (spread > 0).astype(jnp.int32),
+            jnp.sum(delivered.astype(jnp.int32)))

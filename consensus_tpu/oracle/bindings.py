@@ -63,12 +63,12 @@ def get_lib() -> ctypes.CDLL:
     lib.ctpu_paxos_run.restype = ctypes.c_int
     lib.ctpu_paxos_run.argtypes = [u64] + [u32] * 17 + [p32, p8, p32, p32, p32]
     lib.ctpu_pbft_run.restype = ctypes.c_int
-    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 24 + [p8, p32, p32]
+    lib.ctpu_pbft_run.argtypes = [u64] + [u32] * 26 + [p8, p32, p32]
     pi32 = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
     lib.ctpu_dpos_run.restype = ctypes.c_int
     lib.ctpu_dpos_run.argtypes = [u64] + [u32] * 16 + [p32] * 3 + [pi32]
     lib.ctpu_hotstuff_run.restype = ctypes.c_int
-    lib.ctpu_hotstuff_run.argtypes = [u64] + [u32] * 22 + [p8, p32, p32, p32]
+    lib.ctpu_hotstuff_run.argtypes = [u64] + [u32] * 24 + [p8, p32, p32, p32]
     _lib = lib
     return lib
 
@@ -160,6 +160,7 @@ def pbft_run(cfg, sweep: int = 0, delivery: str = "auto"):
         1 if cfg.net_model == "switch" else 0, cfg.n_aggregators,
         cfg.agg_fail_cutoff, cfg.agg_stale_cutoff, cfg.agg_max_stale,
         cfg.agg_byz, cfg.agg_poison_cutoff, cfg.byz_uplink_cutoff,
+        cfg.desync_cutoff, cfg.max_skew_rounds,
         out["committed"].reshape(-1), out["dval"].reshape(-1), out["view"])
     if rc != 0:
         raise RuntimeError(f"oracle pbft_run failed rc={rc}")
@@ -188,6 +189,7 @@ def hotstuff_run(cfg, sweep: int = 0):
         1 if cfg.net_model == "switch" else 0, cfg.n_aggregators,
         cfg.agg_fail_cutoff, cfg.agg_stale_cutoff, cfg.agg_max_stale,
         cfg.agg_byz, cfg.agg_poison_cutoff, cfg.byz_uplink_cutoff,
+        cfg.desync_cutoff, cfg.max_skew_rounds,
         out["committed"].reshape(-1), out["dval"].reshape(-1),
         out["clen"], out["view"])
     if rc != 0:

@@ -169,14 +169,41 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
                     "stale-in-switch-state axis, PAPERS.md 1605.05619), "
                     "so QCs fail, the pacemaker burns view timeouts, "
                     "and the chained 3-commit stalls — switch-vs-replica "
-                    "divergence bounded by the flight recorder.",
+                    "divergence bounded by the flight recorder. (Dip "
+                    "bound retuned for the SPEC §B per-node "
+                    "synchronizer: highest-QC gossip re-syncs views "
+                    "faster than the retired global pacemaker did, so "
+                    "availability under this attack sits higher.)",
         protocol="hotstuff",
         overrides=dict(net_model="switch", n_aggregators=2,
                        agg_fail_rate=0.3, agg_stale_rate=0.5,
                        agg_max_stale=4, drop_rate=0.2, view_timeout=4),
-        bounds=TimelineBounds(max_availability=0.6, min_availability=0.1,
+        bounds=TimelineBounds(max_availability=0.8, min_availability=0.1,
                               min_stall_windows=4,
                               max_recovery_rounds=48),
+        window=4,
+        tuned=dict(n_nodes=7, f=2, n_rounds=96, log_capacity=96)),
+    Scenario(
+        name="view-desync-storm",
+        description="SPEC §B per-node view desync on chained HotStuff: "
+                    "STREAM_DESYNC timer skew fires premature local view "
+                    "changes while a heavy drop rate keeps the highest-QC "
+                    "gossip from healing the spread within the round — "
+                    "nodes disagree about who leads, proposals land on "
+                    "receivers already past the proposer's view, and "
+                    "commits stutter until catch-up wins (the "
+                    "view-synchronization liveness attack of the "
+                    "pacemaker literature; PAPERS.md 2007.12637).",
+        protocol="hotstuff",
+        overrides=dict(desync_rate=0.15, max_skew_rounds=4,
+                       drop_rate=0.25, view_timeout=4),
+        bounds=TimelineBounds(max_availability=0.9, min_availability=0.2,
+                              min_stall_windows=1,
+                              max_recovery_rounds=96,
+                              min_counters={"view_spread_max": 2,
+                                            "desync_rounds": 1,
+                                            "sync_msgs_delivered": 1},
+                              max_counters={"safety_violations": 0}),
         window=4,
         tuned=dict(n_nodes=7, f=2, n_rounds=96, log_capacity=96)),
     Scenario(

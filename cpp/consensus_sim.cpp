@@ -57,6 +57,9 @@ struct Args {
   // SPEC §9b poisoned aggregation (pbft/hotstuff switch models only).
   uint32_t agg_byz = 0;
   double agg_poison_rate = 0.0, byz_uplink_rate = 0.0;
+  // SPEC §B per-node view-synchronizer timer skew (pbft/hotstuff).
+  double desync_rate = 0.0;
+  uint32_t max_skew_rounds = 1;
   uint32_t f = 1, view_timeout = 8, n_byzantine = 0;
   std::string byz_mode = "silent";
   std::string fault_model = "edge";  // "edge" (SPEC §2) | "bcast" (§6b, pbft)
@@ -108,6 +111,7 @@ uint32_t prob_threshold_u32(double p) {
       "  [--net-model flat|switch] [--n-aggregators K]   (SPEC 9)\n"
       "  [--agg-fail-rate P] [--agg-stale-rate P] [--agg-max-stale D]\n"
       "  [--agg-byz K] [--agg-poison-rate P] [--byz-uplink-rate P] (SPEC 9b)\n"
+      "  [--desync-rate P] [--max-skew-rounds K] (SPEC B; pbft,hotstuff)\n"
       "  [--f F] [--view-timeout T] [--n-byzantine K]\n"
       "  [--byz-mode silent|equivocate] [--fault-model edge|bcast]\n"
       "  [--oracle-delivery auto|dense|edge]  (cpu engine; digests equal)\n"
@@ -159,6 +163,8 @@ Args parse(int argc, char** argv) {
     else if (k == "--agg-byz") a.agg_byz = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--agg-poison-rate") a.agg_poison_rate = std::strtod(need(k.c_str()), nullptr);
     else if (k == "--byz-uplink-rate") a.byz_uplink_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--desync-rate") a.desync_rate = std::strtod(need(k.c_str()), nullptr);
+    else if (k == "--max-skew-rounds") a.max_skew_rounds = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--f") a.f = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--view-timeout") a.view_timeout = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
     else if (k == "--n-byzantine") a.n_byzantine = uint32_t(std::strtoul(need(k.c_str()), nullptr, 10));
@@ -305,6 +311,23 @@ Args parse(int argc, char** argv) {
                  "--max-delay-rounds must be in [0, 16] (SPEC A.2)\n");
     std::exit(2);
   }
+  if (a.desync_rate > 0 && a.protocol != "pbft" && a.protocol != "hotstuff") {
+    std::fprintf(stderr,
+                 "--desync-rate (SPEC B) skews the per-node view timers of "
+                 "the pbft/hotstuff synchronizers; %s has no view timer and "
+                 "would silently ignore it\n", a.protocol.c_str());
+    std::exit(2);
+  }
+  if (a.max_skew_rounds < 1 || a.max_skew_rounds > 8) {
+    std::fprintf(stderr, "--max-skew-rounds must be in [1, 8] (SPEC B)\n");
+    std::exit(2);
+  }
+  if (a.max_skew_rounds != 1 && a.desync_rate == 0.0) {
+    std::fprintf(stderr,
+                 "--max-skew-rounds requires --desync-rate > 0 (SPEC B) — "
+                 "it would be silently ignored\n");
+    std::exit(2);
+  }
   if (a.oracle_delivery != "auto" &&
       (a.protocol == "dpos" || a.protocol == "hotstuff")) {
     std::fprintf(stderr,
@@ -382,6 +405,8 @@ int run_cpu(const Args& a) {
   cfg.agg_byz = a.agg_byz;
   cfg.agg_poison_cut = prob_threshold_u32(a.agg_poison_rate);
   cfg.byz_uplink_cut = prob_threshold_u32(a.byz_uplink_rate);
+  cfg.desync_cut = prob_threshold_u32(a.desync_rate);
+  cfg.max_skew = a.max_skew_rounds;
   cfg.f = a.f;
   cfg.view_timeout = a.view_timeout;
   cfg.n_byzantine = a.n_byzantine;

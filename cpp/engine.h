@@ -62,6 +62,12 @@ struct SimConfig {
   // (round / suppress_window, producer) — a suppressed producer misses
   // every slot inside the window (dpos only).
   uint32_t suppress_cut = 0, suppress_window = 16;
+  // SPEC §B per-node view-synchronizer timer skew (pbft, hotstuff —
+  // the per-node pacemakers): each up node's local view timer jumps
+  // ahead by 1 + (depth draw % max_skew) rounds with probability
+  // desync_cut per (round, node) (STREAM_DESYNC subdraws 0/1),
+  // firing premature local timeouts that desynchronize views.
+  uint32_t desync_cut = 0, max_skew = 1;
   // Oracle delivery-layer strategy (execution only — decided logs are
   // byte-identical either way, SPEC §2 draws are pure counter functions):
   // 0 = auto (per-engine choice), 1 = dense [N,N] materialization,

@@ -871,6 +871,8 @@ struct PbftSim {
   uint32_t net_switch = 0, n_agg = 0;
   uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
   AggNet agg;
+  // SPEC §B view-synchronizer timer skew (0 = off).
+  uint32_t desync_cut = 0, max_skew = 1;
 
   // The §6 dense tallies walk ~every (i, j) pair anyway, so the
   // materialized Net stays the auto choice for the edge fault model;
@@ -963,6 +965,17 @@ struct PbftSim {
       if (crash.on)
         for (uint32_t i = 0; i < N; ++i)
           if (crash.rec[i]) { view[i] = 0; timer[i] = 0; }
+      // SPEC §B timer-skew injection (engines/pbft.py placement: after
+      // the volatile reset, before churn): an up node's local timer
+      // jumps ahead so P2's start-of-round timeout fires prematurely.
+      // Down nodes draw nothing — the JAX freeze discards their skew.
+      if (desync_cut)
+        for (uint32_t i = 0; i < N; ++i) {
+          if (crash.is_down(i)) continue;
+          if (random_u32(seed, STREAM_DESYNC, r, 0, i) < desync_cut)
+            timer[i] +=
+                1 + random_u32(seed, STREAM_DESYNC, r, 1, i) % max_skew;
+        }
       if (fault_bcast)
         bnet.begin_round(seed, N, r, drop_cut, part_cut, max_delay,
                          crash.up_mask());
@@ -1806,18 +1819,22 @@ struct HotstuffSim {
   uint32_t net_switch = 0, n_agg = 0;
   uint32_t agg_fail_cut = 0, agg_stale_cut = 0, agg_max_stale = 1;
   AggNet agg;
+  // SPEC §B view-synchronizer timer skew (0 = off).
+  uint32_t desync_cut = 0, max_skew = 1;
 
   // SPEC §7c fork-certificate table depth — mirrors
   // engines/hotstuff.py FORK_TABLE (at most this many forked QCs are
   // value-tracked; later forks still alter nothing durable).
   static constexpr uint32_t FORK_TABLE = 8;
 
-  // Global pacemaker + QC-chain state (the network's shared state —
-  // without an equivocating leader forks are unreachable: a QC
-  // certifies one block per height and the next proposal extends the
-  // newest QC; SPEC §7c re-admits them via per-receiver proposal
-  // variants and double-voting byzantine replicas).
-  uint32_t gview = 0, gtimer = 0, gcommit = 0;
+  // QC-chain state (the network's shared chain — without an
+  // equivocating leader forks are unreachable: a QC certifies one
+  // block per height and the next proposal extends the newest QC;
+  // SPEC §7c re-admits them via per-receiver proposal variants and
+  // double-voting byzantine replicas). The PACEMAKER is per node since
+  // the SPEC §B view-synchronizer PR: view_[i]/timer[i] below advance
+  // on locally observed progress and local timeouts only.
+  uint32_t gcommit = 0;
   int32_t b1_v = -1, b1_h = -1, b2_v = -1, b2_h = -1, b3_v = -1, b3_h = -1;
   std::vector<int32_t> chain_view;  // [S]; -1 = height never certified
   std::vector<int32_t> chain_vid;   // [S] §7c canonical value-id (0/1)
@@ -1836,7 +1853,7 @@ struct HotstuffSim {
   bool honest(uint32_t i) const { return i < N - n_byz; }
 
   void run() {
-    gview = gtimer = gcommit = 0;
+    gcommit = 0;
     b1_v = b1_h = b2_v = b2_h = b3_v = b3_h = -1;
     chain_view.assign(S, -1);
     chain_vid.assign(S, 0);
@@ -1889,58 +1906,97 @@ struct HotstuffSim {
       agg.begin_round(seed, N, n_agg, r, drop_cut, part_cut, max_delay,
                       agg_fail_cut, agg_stale_cut, agg_max_stale);
 
-    // P0 churn: the view's leader skips its slot this round.
-    const bool churn = churn_fires(seed, r, churn_cut);
+    // SPEC §B timer-skew injection: the skewed timer crosses
+    // view_timeout HERE, before any proposal can reset it — the node
+    // abandons its view prematurely (engines/hotstuff.py pre-round
+    // timeout). Down nodes draw nothing (the JAX freeze discards
+    // their skew). Timers never exceed view_timeout - 1 at round
+    // start without skew, so the whole block is gated.
+    if (desync_cut)
+      for (uint32_t i = 0; i < N; ++i) {
+        if (crash.is_down(i)) continue;
+        if (random_u32(seed, STREAM_DESYNC, r, 0, i) < desync_cut)
+          timer[i] +=
+              1 + random_u32(seed, STREAM_DESYNC, r, 1, i) % max_skew;
+        if (timer[i] >= view_timeout) { view_[i] += 1; timer[i] = 0; }
+      }
 
-    // P1 proposal: leader(gview) extends the newest QC at height
-    // b1_h + 1. Silent-byzantine and down leaders withhold it; under
-    // SPEC §7c (equiv) a byzantine leader DOES propose — two block
-    // variants for the same (view, height), each receiver shown one.
-    const uint32_t L = gview % N;
-    const int32_t h_next = b1_h + 1;
+    // P0 churn: every would-be proposer skips its slot this round.
+    const bool churn = churn_fires(seed, r, churn_cut);
     const bool eqv = equiv && n_byz > 0;
-    const bool byzL = !honest(L);
-    const bool proposing = !churn && (eqv || honest(L)) &&
-                           h_next < int32_t(S) && !crash.is_down(L);
     const bool part_active =
         random_u32(seed, STREAM_PARTITION, r, 0, 0) < part_cut;
-    const uint32_t side_L =
-        random_u32(seed, STREAM_PARTITION, r, 1, L) & 1u;
+
+    // SPEC §2 openness of the src→j broadcast leg on the absolute
+    // edge key (+ §A.2 retransmission; partitions are topology faults
+    // — never repaired). Per (round, edge): flights sharing an edge
+    // in one round share its fate, exactly like the engine.
+    auto bopen = [&](uint32_t src, uint32_t j) {
+      bool open = delivery_u32(seed, r, src, j) >= drop_cut;
+      if (!open && max_delay)
+        open = delayed_open(seed, r, src, j, drop_cut, max_delay);
+      return open &&
+             (!part_active ||
+              (random_u32(seed, STREAM_PARTITION, r, 1, j) & 1u) ==
+                  (random_u32(seed, STREAM_PARTITION, r, 1, src) & 1u));
+    };
+
+    // P1 highest-view gossip (SPEC §B view-sync message): the
+    // highest-view honest live node — lowest id on ties — broadcasts
+    // its view; receivers behind it catch up. One O(N) row through
+    // the §2 delivery layer.
+    int64_t vM = -1;
+    uint32_t M = N;
+    for (uint32_t i = 0; i < N; ++i)
+      if (honest(i) && !crash.is_down(i) && int64_t(view_[i]) > vM) {
+        vM = view_[i];
+        M = i;
+      }
+    std::vector<uint8_t> advg(N, 0);
+    if (vM >= 0)
+      for (uint32_t j = 0; j < N; ++j) {
+        if (j == M || crash.is_down(j)) continue;
+        if (int64_t(view_[j]) < vM && bopen(M, j)) {
+          advg[j] = 1;
+          view_[j] = uint32_t(vM);
+        }
+      }
+
+    // P2 proposal: node i proposes iff ITS view elects it (view[i]
+    // mod N == i — the §B per-receiver leader identity) and extends
+    // the newest QC at height b1_h + 1. With desynced views several
+    // nodes may propose at once; the round's EFFECTIVE proposal is
+    // the highest-view one (Vstar — stale proposals lose, and a
+    // receiver ignores views below its own). Silent-byzantine and
+    // down proposers withhold; under SPEC §7c (equiv) a byzantine
+    // proposer DOES propose — two block variants for the same (view,
+    // height), each receiver shown one.
+    const int32_t h_next = b1_h + 1;
+    int64_t Vstar = -1;
+    if (!churn && h_next < int32_t(S))
+      for (uint32_t i = 0; i < N; ++i) {
+        if (view_[i] % N != i || crash.is_down(i)) continue;
+        if (!eqv && !honest(i)) continue;
+        if (int64_t(view_[i]) > Vstar) Vstar = view_[i];
+      }
+    const bool exists = Vstar >= 0;
+    const uint32_t L = exists ? uint32_t(Vstar) % N : 0;
+    const bool byzL = !honest(L);
     const uint32_t start_commit = gcommit;  // what the proposal carries
 
     std::vector<uint8_t> pdel(N, 0), evid(N, 0);
-    if (proposing) {
+    if (exists)
       for (uint32_t j = 0; j < N; ++j) {
         if (crash.is_down(j)) continue;  // down receivers hear nothing
-        bool del = j == L;
-        if (!del) {
-          // SPEC §2 drop leg on the absolute edge key (r, L, j),
-          // repaired by a §A.2 delayed retransmission; partitions are
-          // topology faults — never repaired.
-          bool open = delivery_u32(seed, r, L, j) >= drop_cut;
-          if (!open && max_delay)
-            open = delayed_open(seed, r, L, j, drop_cut, max_delay);
-          del = open &&
-                (!part_active ||
-                 (random_u32(seed, STREAM_PARTITION, r, 1, j) & 1u) ==
-                     side_L);
-        }
-        if (!del) continue;
+        if (int64_t(view_[j]) > Vstar) continue;  // ahead: stale to j
+        if (j != L && !bopen(L, j)) continue;
         pdel[j] = 1;
         // §7c per-receiver value-id: which variant the byzantine
         // leader showed j — the pbft family's sup(r, i, j) keying.
         // Honest leaders pin every receiver to variant 0.
         if (eqv && byzL)
           evid[j] = random_u32(seed, STREAM_EQUIV, r, L, j) & 1u;
-        // P4 learning: the proposal carries the pacemaker view and the
-        // commit state as of proposal time.
-        view_[j] = gview;
-        timer[j] = 0;
-        clen[j] = std::max(clen[j], start_commit);
       }
-    }
-    for (uint32_t j = 0; j < N; ++j)
-      if (!crash.is_down(j) && !pdel[j]) timer[j] += 1;
 
     // P2 votes: per-variant tallies (SPEC §7c — silent mode keeps one;
     // cnt1 stays 0 there). Byzantine replicas under equiv double-vote
@@ -1950,7 +2006,7 @@ struct HotstuffSim {
     // full-segment width to both, which is how a poisoned switch
     // vertex forges a forked QC without real double votes.
     uint32_t cnt0 = 0, cnt1 = 0;
-    if (proposing && !net_switch) {
+    if (exists && !net_switch) {
       for (uint32_t j = 0; j < N; ++j) {
         if (!pdel[j]) continue;
         // The vote is the return flight on edge (j, L); given pdel, a
@@ -1969,7 +2025,7 @@ struct HotstuffSim {
           ++cnt0; ++cnt1;  // §7c maximal double-vote
         }
       }
-    } else if (proposing) {
+    } else if (exists) {
       // SPEC §9: votes route through the K aggregators (phase 0); the
       // leader sees K pre-aggregated segment counts. Scalar twin of
       // the engine's _count over ops/aggregate primitives.
@@ -2022,15 +2078,15 @@ struct HotstuffSim {
     // byzantine model deliberately re-admits. The canonical chain
     // prefers variant 0 (deterministic tie-break, mirrored in the
     // engine).
-    const bool qc0 = proposing && cnt0 >= Q;
-    const bool qc1 = eqv && proposing && cnt1 >= Q;
+    const bool qc0 = exists && cnt0 >= Q;
+    const bool qc1 = eqv && exists && cnt1 >= Q;
     const bool qc = qc0 || qc1;
     const bool forked = qc0 && qc1;
     if (qc) {
       b3_v = b2_v; b3_h = b2_h;
       b2_v = b1_v; b2_h = b1_h;
-      b1_v = int32_t(gview); b1_h = h_next;
-      chain_view[h_next] = int32_t(gview);
+      b1_v = int32_t(Vstar); b1_h = h_next;
+      chain_view[h_next] = int32_t(Vstar);
       if (eqv) chain_vid[h_next] = qc0 ? 0 : 1;
       if (b3_v >= 0 && b1_v == b2_v + 1 && b2_v == b3_v + 1)
         gcommit = std::max(gcommit, uint32_t(b3_h + 1));
@@ -2039,7 +2095,7 @@ struct HotstuffSim {
     // honest receiver shown the NON-canonical variant — those nodes
     // durably believe the sibling block sits at this height.
     if (forked && fnum < FORK_TABLE) {
-      ftab_v[fnum] = int32_t(gview);
+      ftab_v[fnum] = int32_t(Vstar);
       ftab_h[fnum] = h_next;
       for (uint32_t j = 0; j < N; ++j)
         if (pdel[j] && honest(j) && evid[j] == 1)
@@ -2047,10 +2103,27 @@ struct HotstuffSim {
       ++fnum;
     }
 
-    // P5 pacemaker: QC advances the view; else timeout after
-    // view_timeout rounds without one.
-    const bool to = !qc && gtimer + 1 >= view_timeout;
-    if (qc || to) { gview += 1; gtimer = 0; } else { gtimer += 1; }
+    // P6 learning + QC-notify: the proposal carries the proposer's
+    // view and the commit state as of proposal time; when the QC
+    // forms, the same open channels carry the certificate back out,
+    // so receivers enter view Vstar + 1 — the within-round notify the
+    // chained pipeline's consecutive-view rule needs.
+    for (uint32_t j = 0; j < N; ++j)
+      if (pdel[j]) {
+        view_[j] = uint32_t(Vstar) + (qc ? 1u : 0u);
+        clen[j] = std::max(clen[j], start_commit);
+      }
+
+    // P7 per-node pacemaker: progress (a delivered proposal or a
+    // view-sync catch-up) resets the local timer; otherwise the
+    // node's OWN view changes after view_timeout local rounds.
+    for (uint32_t j = 0; j < N; ++j) {
+      if (crash.is_down(j)) continue;
+      const bool progress = pdel[j] || advg[j];
+      const bool to = !progress && timer[j] + 1 >= view_timeout;
+      if (to) view_[j] += 1;
+      timer[j] = (progress || to) ? 0 : timer[j] + 1;
+    }
   }
 };
 
@@ -2167,13 +2240,15 @@ class PbftEngine final : public SlotEngine<PbftSim> {
   const char* name() const override { return "pbft"; }
   int run(const SimConfig& c) override {
     if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f ||
-        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c))
+        c.oracle_delivery > DELIVERY_EDGE || !valid_switch(c) ||
+        c.max_skew < 1 || c.max_skew > 8)  // SPEC §B skew bound
       return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity; sim_.f = c.f;
     sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
     sim_.equiv = c.byz_equivocate;
     sim_.fault_bcast = c.fault_bcast;
+    sim_.desync_cut = c.desync_cut; sim_.max_skew = c.max_skew;
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
     sim_.delivery = c.oracle_delivery;
@@ -2229,12 +2304,13 @@ class HotstuffEngine final : public SlotEngine<HotstuffSim> {
   const char* name() const override { return "hotstuff"; }
   int run(const SimConfig& c) override {
     if (c.n_nodes != 3 * c.f + 1 || c.n_byzantine > c.f ||
-        !valid_switch(c))
+        !valid_switch(c) || c.max_skew < 1 || c.max_skew > 8)
       return 1;
     sim_.seed = c.seed; sim_.N = c.n_nodes; sim_.R = c.n_rounds;
     sim_.S = c.log_capacity; sim_.f = c.f;
     sim_.view_timeout = c.view_timeout; sim_.n_byz = c.n_byzantine;
     sim_.equiv = c.byz_equivocate;  // SPEC §7c fork model
+    sim_.desync_cut = c.desync_cut; sim_.max_skew = c.max_skew;
     sim_.drop_cut = c.drop_cut; sim_.part_cut = c.part_cut;
     sim_.churn_cut = c.churn_cut;
     sim_.crash_cut = c.crash_cut; sim_.recover_cut = c.recover_cut;
@@ -2381,11 +2457,14 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                   uint32_t agg_stale_cut, uint32_t agg_max_stale,
                   uint32_t agg_byz,        // SPEC §9b poisoned combines
                   uint32_t agg_poison_cut, uint32_t byz_uplink_cut,
+                  uint32_t desync_cut,      // SPEC §B timer skew
+                  uint32_t max_skew,        // skew depth bound [1, 8]
                   uint8_t* out_committed,   // [N*S]
                   uint32_t* out_dval,       // [N*S]
                   uint32_t* out_view) {     // [N]
   if (n_nodes != 3 * f + 1 || n_byzantine > f || oracle_delivery > 2 ||
-      max_delay > 16)
+      max_delay > 16 ||
+      max_skew < 1 || max_skew > 8)
     return 1;
   if (!ctpu::valid_switch(net_switch, n_aggregators, n_nodes,
                           agg_fail_cut, agg_stale_cut, agg_max_stale) ||
@@ -2397,6 +2476,7 @@ int ctpu_pbft_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
   sim.equiv = byz_equivocate;
   sim.fault_bcast = fault_bcast;
+  sim.desync_cut = desync_cut; sim.max_skew = max_skew;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
   sim.delivery = oracle_delivery;
   sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
@@ -2504,11 +2584,15 @@ int ctpu_hotstuff_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
                       uint32_t agg_stale_cut, uint32_t agg_max_stale,
                       uint32_t agg_byz,        // SPEC §9b poisoned combines
                       uint32_t agg_poison_cut, uint32_t byz_uplink_cut,
+                      uint32_t desync_cut,      // SPEC §B timer skew
+                      uint32_t max_skew,        // skew depth bound [1, 8]
                       uint8_t* out_committed,   // [N*S]
                       uint32_t* out_dval,       // [N*S]
                       uint32_t* out_clen,       // [N]
                       uint32_t* out_view) {     // [N]
-  if (n_nodes != 3 * f + 1 || n_byzantine > f || max_delay > 16) return 1;
+  if (n_nodes != 3 * f + 1 || n_byzantine > f || max_delay > 16 ||
+      max_skew < 1 || max_skew > 8)
+    return 1;
   if (!ctpu::valid_switch(net_switch, n_aggregators, n_nodes,
                           agg_fail_cut, agg_stale_cut, agg_max_stale) ||
       !ctpu::valid_poison(net_switch, n_aggregators, agg_byz,
@@ -2519,6 +2603,7 @@ int ctpu_hotstuff_run(uint64_t seed, uint32_t n_nodes, uint32_t n_rounds,
   sim.f = f; sim.view_timeout = view_timeout; sim.n_byz = n_byzantine;
   sim.equiv = byz_equivocate;
   sim.drop_cut = drop_cut; sim.part_cut = part_cut; sim.churn_cut = churn_cut;
+  sim.desync_cut = desync_cut; sim.max_skew = max_skew;
   sim.crash_cut = crash_cut; sim.recover_cut = recover_cut;
   sim.max_crashed = max_crashed; sim.max_delay = max_delay;
   sim.net_switch = net_switch; sim.n_agg = n_aggregators;

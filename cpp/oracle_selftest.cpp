@@ -23,7 +23,7 @@ int ctpu_pbft_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                   uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                  uint32_t,
+                  uint32_t, uint32_t, uint32_t,
                   uint8_t*, uint32_t*, uint32_t*);
 int ctpu_paxos_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                    uint32_t, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
@@ -38,7 +38,8 @@ int ctpu_hotstuff_run(uint64_t, uint32_t, uint32_t, uint32_t, uint32_t,
                       uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                       uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
                       uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
-                      uint32_t, uint32_t, uint32_t,
+                      uint32_t, uint32_t, uint32_t, uint32_t,
+                      uint32_t,
                       uint8_t*, uint32_t*, uint32_t*, uint32_t*);
 }
 
@@ -164,27 +165,27 @@ int main() {
     size_t W = (ns + 3) / 4 + ns + N;
     rc |= run_twice("pbft", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 1, 0, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     rc |= run_twice("pbft-equiv", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, 0, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // SPEC §6b broadcast-atomic fault model, with equivocation.
     rc |= run_twice("pbft-bcast", W, [&](uint32_t* o) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, 0, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
     // §6 edge model: dense vs forced edge-wise delivery queries.
     rc |= run_match("pbft-delivery", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN, d, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -192,7 +193,7 @@ int main() {
     // direct per-receiver definition (forced dense).
     rc |= run_match("pbft-bcast-agg", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d, 0, 0, 0, 0,
-                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0,
+                           /*§9 flat*/ 0, 0, 0, 0, 1, /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -200,7 +201,7 @@ int main() {
     rc |= run_match("pbft-bcast-crash", W, [&](uint32_t* o, uint32_t d) {
       return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN, d,
                            CRASH, REC, 2, 3, /*§9 flat*/ 0, 0, 0, 0, 1,
-                           /*§9b flat*/ 0, 0, 0,
+                           /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                            reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                            o + (ns + 3) / 4 + ns);
     });
@@ -214,7 +215,7 @@ int main() {
     rc |= run_twice("hotstuff", W, [&](uint32_t* o) {
       return ctpu_hotstuff_run(33, N, R, S, f, 8, 1, 0, DROP, PART, CHURN,
                                0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
-                               /*§9b flat*/ 0, 0, 0,
+                               /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                                reinterpret_cast<uint8_t*>(o),
                                o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                o + (ns + 3) / 4 + ns + N);
@@ -224,7 +225,7 @@ int main() {
     rc |= run_twice("hotstuff-equiv", W, [&](uint32_t* o) {
       return ctpu_hotstuff_run(33, N, R, S, f, 8, 2, 1, DROP, PART, CHURN,
                                0, 0, 0, 0, /*§9 flat*/ 0, 0, 0, 0, 1,
-                               /*§9b flat*/ 0, 0, 0,
+                               /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                                reinterpret_cast<uint8_t*>(o),
                                o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                o + (ns + 3) / 4 + ns + N);
@@ -232,7 +233,7 @@ int main() {
     rc |= run_twice("hotstuff-crash-delay", W, [&](uint32_t* o) {
       return ctpu_hotstuff_run(33, N, R, S, f, 8, 0, 0, DROP, PART, CHURN,
                                CRASH, REC, 2, 4, /*§9 flat*/ 0, 0, 0, 0, 1,
-                               /*§9b flat*/ 0, 0, 0,
+                               /*§9b flat*/ 0, 0, 0, /*§B off*/ 0, 1,
                                reinterpret_cast<uint8_t*>(o),
                                o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                o + (ns + 3) / 4 + ns + N);
@@ -307,7 +308,7 @@ int main() {
         return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 0, DROP, PART, CHURN,
                              0, CRASH, REC, 2, 2,
                              /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
-                             /*§9b off*/ 0, 0, 0,
+                             /*§9b off*/ 0, 0, 0, /*§B off*/ 0, 1,
                              reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                              o + (ns + 3) / 4 + ns);
       });
@@ -315,7 +316,7 @@ int main() {
         return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN,
                              0, 0, 0, 0, 2,
                              /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
-                             /*§9b off*/ 0, 0, 0,
+                             /*§9b off*/ 0, 0, 0, /*§B off*/ 0, 1,
                              reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                              o + (ns + 3) / 4 + ns);
       });
@@ -342,7 +343,7 @@ int main() {
         return ctpu_hotstuff_run(33, N, R, S, f, 4, 1, 0, DROP, PART, CHURN,
                                  CRASH, REC, 2, 2,
                                  /*§9 switch*/ 1, 2, AGGF, AGGS, 4,
-                                 /*§9b off*/ 0, 0, 0,
+                                 /*§9b off*/ 0, 0, 0, /*§B off*/ 0, 1,
                                  reinterpret_cast<uint8_t*>(o),
                                  o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                  o + (ns + 3) / 4 + ns + N);
@@ -362,7 +363,7 @@ int main() {
           return ctpu_pbft_run(77, N, R, S, f, 8, 2, 1, 1, DROP, PART, CHURN,
                                0, CRASH, REC, 2, 2,
                                /*§9 switch*/ 1, 3, AGGF, AGGS, 3,
-                               /*§9b*/ 1, AGGP, UPL,
+                               /*§9b*/ 1, AGGP, UPL, /*§B off*/ 0, 1,
                                reinterpret_cast<uint8_t*>(o), o + (ns + 3) / 4,
                                o + (ns + 3) / 4 + ns);
         });
@@ -375,7 +376,7 @@ int main() {
           return ctpu_hotstuff_run(33, N, R, S, f, 4, 2, 1, DROP, PART, CHURN,
                                    CRASH, REC, 2, 2,
                                    /*§9 switch*/ 1, 2, AGGF, AGGS, 4,
-                                   /*§9b*/ 1, AGGP, UPL,
+                                   /*§9b*/ 1, AGGP, UPL, /*§B off*/ 0, 1,
                                    reinterpret_cast<uint8_t*>(o),
                                    o + (ns + 3) / 4, o + (ns + 3) / 4 + ns,
                                    o + (ns + 3) / 4 + ns + N);

@@ -25,6 +25,7 @@ constexpr uint32_t STREAM_DELAY     = 0x2545F491u;  // SPEC §A.2 retransmit
 constexpr uint32_t STREAM_AGG       = 0x510E527Fu;  // SPEC §9 aggregator faults
 constexpr uint32_t STREAM_POISON    = 0x6A09E667u;  // SPEC §9b poisoned combines
 constexpr uint32_t STREAM_SUPPRESS  = 0x1F83D9ABu;  // SPEC §A.4 producer runs
+constexpr uint32_t STREAM_DESYNC    = 0x5BE0CD19u;  // SPEC §B view-timer skew
 
 inline uint32_t rotl32(uint32_t x, int r) {
   return (x << r) | (x >> (32 - r));

@@ -1,0 +1,325 @@
+"""The RETIRED global-pacemaker HotStuff round, kept verbatim as a
+test-only reference (PR "view-desync", the PR 8 twin playbook).
+
+This is the engines/hotstuff.py kernel as committed before the SPEC §B
+per-node view synchronizer: the pacemaker (`gview`, `gtimer`) is ONE
+scalar pair per sweep — the whole network idealized as agreeing on the
+current view — the leader is the global `gview mod N`, and a node's
+`view` field merely records the last view it synced to.
+
+Job: bit-identity anchor for the synchronizer's sync path —
+tests/test_hotstuff.py drives this round and the production per-node
+round through the SAME runner over configs whose views stay in
+lockstep (zero delivery-fault rates; churn / silent & equivocating byz
+allowed — both stall every node identically) and asserts the decided
+logs, chain state, and per-node prefixes are identical, with the
+production per-node `view` equal to the retired GLOBAL `gview`
+(production view[i] tracks the node's OWN pacemaker, one ahead of the
+retired sync record). Any pacemaker regression that shifts the sync
+path shows up here, not three PRs later in an oracle differential.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from consensus_tpu.core import rng
+from consensus_tpu.core.config import Config
+from consensus_tpu.engines.hotstuff import (FORK_TABLE, HOTSTUFF_TELEMETRY,
+                                            _block_val)
+from consensus_tpu.network.runner import EngineDef
+from consensus_tpu.ops.adversary import (crash_counts, crash_transition,
+                                         delayed_open, freeze_down,
+                                         safety_counts)
+from consensus_tpu.ops.adversary import cutoff as _lt
+from consensus_tpu.ops.adversary import draw as _draw
+from consensus_tpu.ops.aggregate import (agg_counts, agg_ids, agg_poison,
+                                         agg_round, downlink, poison_count,
+                                         seg_sum, seg_widths, take_seg,
+                                         uplink_edge, uplink_lies)
+from consensus_tpu.ops.viewsync import sync_counts
+
+
+class RefHotstuffState(NamedTuple):
+    """The retired carry: the production fields plus the global
+    pacemaker scalars the synchronizer distributed into view/timer."""
+    seed: jnp.ndarray
+    gview: jnp.ndarray      # [] i32 — the retired global pacemaker view
+    gtimer: jnp.ndarray     # [] i32 — rounds spent in the current view
+    b1_v: jnp.ndarray
+    b1_h: jnp.ndarray
+    b2_v: jnp.ndarray
+    b2_h: jnp.ndarray
+    b3_v: jnp.ndarray
+    b3_h: jnp.ndarray
+    gcommit: jnp.ndarray
+    chain_v: jnp.ndarray
+    chain_vid: jnp.ndarray
+    fvec: jnp.ndarray
+    ftab_v: jnp.ndarray
+    ftab_h: jnp.ndarray
+    fnum: jnp.ndarray
+    view: jnp.ndarray       # [N] i32 — last view node i SYNCED to
+    timer: jnp.ndarray
+    clen: jnp.ndarray
+    down: jnp.ndarray
+
+
+def ref_hotstuff_init(cfg: Config, seed) -> RefHotstuffState:
+    N, S = cfg.n_nodes, cfg.log_capacity
+    z = jnp.int32(0)
+    none = jnp.int32(-1)
+    return RefHotstuffState(
+        jnp.asarray(seed, jnp.uint32), z, z, none, none, none, none,
+        none, none, z, jnp.full((S,), -1, jnp.int32),
+        jnp.zeros(S, jnp.int32), jnp.zeros(N, jnp.int32),
+        jnp.full((FORK_TABLE,), -1, jnp.int32),
+        jnp.full((FORK_TABLE,), -1, jnp.int32), z,
+        jnp.zeros(N, jnp.int32), jnp.zeros(N, jnp.int32),
+        jnp.zeros(N, jnp.int32), jnp.zeros(N, bool))
+
+
+def global_pacemaker_round(cfg: Config, st: RefHotstuffState, r, *,
+                           telem: bool = False):
+    """The retired global-pacemaker round, verbatim."""
+    N, S = cfg.n_nodes, cfg.log_capacity
+    Q = 2 * cfg.f + 1
+    seed = st.seed
+    ur = jnp.asarray(r, jnp.uint32)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    uidx = idx.astype(jnp.uint32)
+
+    crash_on = cfg.crash_on
+    down = st.down
+    view, timer, clen = st.view, st.timer, st.clen
+    if crash_on:
+        down, rec, _crashed = crash_transition(
+            seed, ur, down, cfg.crash_cutoff, cfg.recover_cutoff,
+            cfg.max_crashed)
+        view = jnp.where(rec, 0, view)
+        timer = jnp.where(rec, 0, timer)
+        frozen = (view, timer, clen)
+
+    churn = _draw(seed, rng.STREAM_CHURN, ur, 0, 0) < _lt(cfg.churn_cutoff)
+
+    L = st.gview % jnp.int32(N)
+    uL = L.astype(jnp.uint32)
+    honest = idx < (N - cfg.n_byzantine)
+    h_next = st.b1_h + 1
+    equiv = cfg.byz_mode == "equivocate" and cfg.n_byzantine > 0
+    byzL = L >= jnp.int32(N - cfg.n_byzantine)
+    if equiv:
+        proposing = ~churn & (h_next < S)
+    else:
+        proposing = ~churn & ~byzL & (h_next < S)
+    if crash_on:
+        proposing &= ~down[L]
+
+    switch = cfg.switch_on
+    open_p = ~(rng.delivery_u32_jnp(seed, ur, uL, uidx)
+               < _lt(cfg.drop_cutoff))
+    if cfg.max_delay_rounds > 0:
+        open_p |= delayed_open(seed, ur, uL, uidx, cfg.drop_cutoff,
+                               cfg.max_delay_rounds)
+    if not switch:
+        open_v = ~(rng.delivery_u32_jnp(seed, ur, uidx, uL)
+                   < _lt(cfg.drop_cutoff))
+        if cfg.max_delay_rounds > 0:
+            open_v |= delayed_open(seed, ur, uidx, uL, cfg.drop_cutoff,
+                                   cfg.max_delay_rounds)
+    part_active = (_draw(seed, rng.STREAM_PARTITION, ur, 0, 0)
+                   < _lt(cfg.partition_cutoff))
+    side = _draw(seed, rng.STREAM_PARTITION, ur, 1, uidx) & jnp.uint32(1)
+    side_L = _draw(seed, rng.STREAM_PARTITION, ur, 1, uL) & jnp.uint32(1)
+    same_side = (side == side_L) | ~part_active
+
+    pdel = proposing & ((idx == L) | (open_p & same_side))
+    if crash_on:
+        pdel &= ~down
+
+    vote = pdel & honest
+    if equiv:
+        evid = jnp.where(byzL,
+                         (_draw(seed, rng.STREAM_EQUIV, ur, uL, uidx)
+                          & jnp.uint32(1)).astype(jnp.int32),
+                         0)
+        voteb = pdel & ~honest
+    if switch:
+        aggst = agg_round(cfg, seed, ur)
+        K_agg = cfg.n_aggregators
+        sids = agg_ids(N, K_agg)
+        up0 = uplink_edge(cfg, seed, aggst, 0)
+        if crash_on:
+            up0 &= ~down
+        down0 = downlink(cfg, seed, ur, aggst, 0, jnp.reshape(L, (1,)))[:, 0]
+        pz0 = agg_poison(cfg, seed, ur, 0)
+        wid = seg_widths(jnp.ones(N, bool), sids, K_agg) \
+            if pz0 is not None else None
+        lie, _fv = uplink_lies(cfg, seed, ur, ~honest)
+
+        def _served(segx):
+            srv = jnp.where(down0, segx, 0)
+            if pz0 is not None:
+                srv = jnp.where(down0 & pz0, wid, srv)
+            return jnp.sum(srv)
+
+        if pz0 is not None:
+            own = take_seg((pz0 & down0).astype(jnp.int32), sids,
+                           K_agg)[L].astype(bool)
+
+        def _count(sup, self_sup):
+            contrib = sup & (idx != L) & up0
+            seg = seg_sum(contrib.astype(jnp.int32), sids, K_agg)
+            s = self_sup.astype(jnp.int32)
+            if pz0 is not None:
+                s = jnp.where(own, 0, s)
+            return s + _served(seg)
+
+        if equiv:
+            claim = (voteb | lie) if lie is not None else voteb
+            sup0 = (vote & (evid == 0)) | claim
+            sup1 = (vote & (evid == 1)) | claim
+            cnt0 = _count(sup0, sup0[L])
+            cnt1 = _count(sup1, sup1[L])
+        else:
+            sup = (vote | lie) if lie is not None else vote
+            cnt = _count(sup, vote[L])
+    else:
+        pz0 = None
+        if equiv:
+            vd0 = ((vote & (evid == 0)) | voteb) & ((idx == L) | open_v)
+            vd1 = ((vote & (evid == 1)) | voteb) & ((idx == L) | open_v)
+            cnt0 = jnp.sum(vd0.astype(jnp.int32))
+            cnt1 = jnp.sum(vd1.astype(jnp.int32))
+        else:
+            vdel = vote & ((idx == L) | open_v)
+            cnt = jnp.sum(vdel.astype(jnp.int32))
+    if equiv:
+        qc0 = proposing & (cnt0 >= Q)
+        qc1 = proposing & (cnt1 >= Q)
+        qc = qc0 | qc1
+        forked = qc0 & qc1
+        vid = jnp.where(qc0, jnp.int32(0), jnp.int32(1))
+        cnt = cnt0 + cnt1
+    else:
+        qc = proposing & (cnt >= Q)
+
+    b1_v = jnp.where(qc, st.gview, st.b1_v)
+    b1_h = jnp.where(qc, h_next, st.b1_h)
+    b2_v = jnp.where(qc, st.b1_v, st.b2_v)
+    b2_h = jnp.where(qc, st.b1_h, st.b2_h)
+    b3_v = jnp.where(qc, st.b2_v, st.b3_v)
+    b3_h = jnp.where(qc, st.b2_h, st.b3_h)
+    sarange = jnp.arange(S, dtype=jnp.int32)
+    chain_v = jnp.where((sarange == h_next) & qc, st.gview, st.chain_v)
+    consec = (b3_v >= 0) & (b1_v == b2_v + 1) & (b2_v == b3_v + 1)
+    gcommit = jnp.where(qc & consec,
+                        jnp.maximum(st.gcommit, b3_h + 1), st.gcommit)
+
+    if equiv:
+        chain_vid = jnp.where((sarange == h_next) & qc, vid, st.chain_vid)
+        deceived = pdel & honest & (evid == 1)
+        can = forked & (st.fnum < FORK_TABLE)
+        hot = (jnp.arange(FORK_TABLE, dtype=jnp.int32) == st.fnum) & can
+        ftab_v = jnp.where(hot, st.gview, st.ftab_v)
+        ftab_h = jnp.where(hot, h_next, st.ftab_h)
+        fbit = jnp.left_shift(jnp.int32(1),
+                              jnp.minimum(st.fnum, FORK_TABLE - 1))
+        fvec = jnp.where(can & deceived, st.fvec | fbit, st.fvec)
+        fnum = st.fnum + can.astype(jnp.int32)
+    else:
+        chain_vid, fvec = st.chain_vid, st.fvec
+        ftab_v, ftab_h, fnum = st.ftab_v, st.ftab_h, st.fnum
+
+    view = jnp.where(pdel, st.gview, view)
+    clen = jnp.where(pdel, jnp.maximum(clen, st.gcommit), clen)
+    timer = jnp.where(pdel, 0, timer + 1)
+
+    to = ~qc & (st.gtimer + 1 >= cfg.view_timeout)
+    adv = qc | to
+    gview = st.gview + adv.astype(jnp.int32)
+    gtimer = jnp.where(adv, 0, st.gtimer + 1)
+
+    if crash_on:
+        view, timer, clen = freeze_down(down, frozen, (view, timer, clen))
+
+    new = RefHotstuffState(seed, gview, gtimer, b1_v, b1_h, b2_v, b2_h,
+                           b3_v, b3_h, gcommit, chain_v, chain_vid, fvec,
+                           ftab_v, ftab_h, fnum, view, timer, clen, down)
+    if not telem:
+        return new
+    cz = crash_counts(_crashed, rec, down) if crash_on else crash_counts()
+    az = agg_counts(aggst, poison_count(aggst, pz0)) if switch \
+        else agg_counts()
+    if equiv:
+        conf = jnp.zeros((), jnp.int32)
+        for k in range(FORK_TABLE):
+            inw = ((jnp.int32(k) < fnum) & (ftab_h[k] >= st.clen)
+                   & (ftab_h[k] < new.clen))
+            conf += jnp.sum((((fvec >> k) & 1).astype(bool)
+                             & inw).astype(jnp.int32))
+        sz = safety_counts(forked, conf)
+    else:
+        sz = safety_counts()
+    # SPEC §B tail (zeros — the retired round predates the synchronizer
+    # and is only ever compared on lockstep configs, where the
+    # production sync counters are identically zero too).
+    vec = jnp.stack([qc.astype(jnp.int32),
+                     gcommit - st.gcommit,
+                     jnp.sum(new.clen - st.clen),
+                     to.astype(jnp.int32),
+                     jnp.sum(pdel.astype(jnp.int32)),
+                     cnt, *cz, *az, *sz, *sync_counts()])
+    return new, vec
+
+
+def global_pacemaker_round_telem(cfg: Config, st: RefHotstuffState, r):
+    return global_pacemaker_round(cfg, st, r, telem=True)
+
+
+def _ref_extract(st: RefHotstuffState) -> dict:
+    """The production extraction epilogue applied to the retired carry,
+    PLUS the global pacemaker scalars — the twin test maps the
+    production per-node `view` onto the retired `gview`."""
+    S = st.chain_v.shape[-1]
+    sarange = jnp.arange(S, dtype=jnp.int32)
+    committed = sarange[None, None, :] < st.clen[..., None]
+    v0 = _block_val(st.seed[..., None], st.chain_v, sarange[None, :])
+    v1 = _block_val(st.seed[..., None], st.chain_v, sarange[None, :], sub=6)
+    base = jnp.where(st.chain_vid == 1, v1, v0)
+    dval = jnp.where(committed, base[..., None, :], 0)
+    for k in range(FORK_TABLE):
+        ok = jnp.int32(k) < st.fnum
+        hh = st.ftab_h[..., k]
+        alt = _block_val(st.seed, st.ftab_v[..., k], hh, sub=6)
+        hit = (((st.fvec >> k) & 1).astype(bool)[..., None]
+               & (sarange == hh[..., None, None])
+               & ok[..., None, None] & committed)
+        dval = jnp.where(hit, alt[..., None, None], dval)
+    return {"committed": committed, "dval": dval,
+            "clen": st.clen, "gcommit": st.gcommit,
+            "chain_v": st.chain_v, "view": st.view,
+            "fvec": st.fvec, "fnum": st.fnum,
+            "gview": st.gview, "gtimer": st.gtimer}
+
+
+def _ref_pspec(cfg: Config) -> RefHotstuffState:
+    from jax.sharding import PartitionSpec as P
+
+    from consensus_tpu.parallel.mesh import NODE_AXIS as ND
+    g, v = P(), P(ND)
+    return RefHotstuffState(seed=g, gview=g, gtimer=g, b1_v=g, b1_h=g,
+                            b2_v=g, b2_h=g, b3_v=g, b3_h=g, gcommit=g,
+                            chain_v=P(None), chain_vid=P(None), fvec=v,
+                            ftab_v=P(None), ftab_h=P(None), fnum=g,
+                            view=v, timer=v, clen=v, down=v)
+
+
+def reference_engine() -> EngineDef:
+    """The retired round behind the production EngineDef seam, so tests
+    drive it through the same runner/chunk machinery as the real one."""
+    return EngineDef("hotstuff-retired", ref_hotstuff_init,
+                     global_pacemaker_round, _ref_extract, _ref_pspec,
+                     telemetry_names=HOTSTUFF_TELEMETRY,
+                     round_telem=global_pacemaker_round_telem)

@@ -38,6 +38,7 @@ from consensus_tpu.ops.adversary import (crash_counts, crash_transition,
 from consensus_tpu.ops.adversary import draw as _draw
 from consensus_tpu.ops.adversary import cutoff as _lt
 from consensus_tpu.ops.adversary import bitcast_i32 as _i32
+from consensus_tpu.ops.viewsync import sync_counts
 
 I32_MAX = jnp.iinfo(jnp.int32).max
 I32_MIN = jnp.iinfo(jnp.int32).min
@@ -321,10 +322,15 @@ def sorted_tally_round(cfg: Config, st: PbftState, r, *,
         sz = safety_counts(forked, conflicts)
     else:
         sz = safety_counts()
+    # SPEC §B desync tail — same reductions as the production kernel
+    # (the pacemaker was per-node before AND after the sort diet, so the
+    # twin emits LIVE values here, not zeros: view spread and P1
+    # catch-ups under drop/crash must match counter-for-counter).
+    syncz = sync_counts(view, honest & ~down, catch)
     vec = jnp.stack([cnt(prep_new_s), cnt(prep_miss_s), cnt(commit_now_s),
                      cnt(commit_miss_s), cnt(adopt),
                      jnp.sum(jnp.maximum(view - st.view, 0)), *cz, *az,
-                     *sz])
+                     *sz, *syncz])
     return new, vec
 
 

@@ -245,12 +245,19 @@ def test_config_json_roundtrips_adversary_fields():
     atk = Config(protocol="raft", n_nodes=5, attack="sticky",
                  attack_rate=0.7, attack_target=2)
     assert Config.from_json(atk.to_json()) == atk
+    dsn = Config(protocol="hotstuff", f=2, n_nodes=7, desync_rate=0.15,
+                 max_skew_rounds=4, view_timeout=4)
+    assert Config.from_json(dsn.to_json()) == dsn
     # Pre-Appendix-A config dicts load with the library off.
     old = Config.from_json(json.dumps({"protocol": "dpos", "n_nodes": 24,
                                        "n_candidates": 12,
                                        "n_producers": 5}))
     assert old.miss_rate == 0.0 and old.max_delay_rounds == 0 \
         and old.attack == "none"
+    # Pre-SPEC-B config dicts load with the synchronizer in sync path.
+    pre_b = Config.from_json(json.dumps({"protocol": "pbft", "f": 2,
+                                         "n_nodes": 7}))
+    assert pre_b.desync_rate == 0.0 and pre_b.max_skew_rounds == 1
 
 
 # --- 4. DPoS forks / LIB under gaps (SPEC §A.1 + §7) ------------------------
@@ -359,6 +366,9 @@ SCENARIO_SHAPES = {
     "stale-aggregator-inconsistency": Config(
         protocol="hotstuff", f=2, n_nodes=7, n_rounds=96,
         log_capacity=96, n_sweeps=2, seed=11),
+    "view-desync-storm": Config(
+        protocol="hotstuff", f=2, n_nodes=7, n_rounds=96,
+        log_capacity=96, n_sweeps=2, seed=11),
     # advsearch-discovered (tools/advsearch, scenarios/discovered.json):
     # the search's low-drop compound collapse — same tuned shape the
     # distiller verified at.
@@ -369,6 +379,12 @@ SCENARIO_SHAPES = {
     # uplinks fork hotstuff QCs at availability 1.0 — tuned shape from
     # the hotstuff-forked-qc space, promoted across seeds 11/23/37.
     "discovered-silent-qc-fork": Config(
+        protocol="hotstuff", f=2, n_nodes=7, n_rounds=96,
+        log_capacity=96, view_timeout=4, n_sweeps=2, seed=11),
+    # the SPEC §B compound collapse from the hotstuff-view-desync
+    # space: timer skew + heavy drops kill commits outright (promoted
+    # across seeds 11/23/37).
+    "discovered-desync-commit-collapse": Config(
         protocol="hotstuff", f=2, n_nodes=7, n_rounds=96,
         log_capacity=96, view_timeout=4, n_sweeps=2, seed=11),
 }
@@ -605,6 +621,31 @@ def test_python_cli_hotstuff_smoke_verdict(capsys):
     # view changes) while commits still flow.
     assert out["telemetry"]["view_changes"] > 0
     assert out["telemetry"]["commits_learned"] > 0
+
+
+def test_python_cli_desync_smoke_verdict(capsys):
+    """The SPEC §B `make check` smoke (tools/check.DESYNC_SMOKE): the
+    EXACT CI invocation of the view-desync storm runs at the scenario's
+    tuned reference shape and passes its bounds — and the synchronizer
+    telemetry is live in the CLI report (views genuinely spread)."""
+    from consensus_tpu import cli
+    from consensus_tpu import scenarios
+    from tools.check import DESYNC_SMOKE
+    argv = DESYNC_SMOKE[DESYNC_SMOKE.index("--scenario"):]
+    smoke_cfg = scenarios.apply(
+        cli.args_to_config(cli.build_parser().parse_args(argv)),
+        scenarios.get("view-desync-storm"))
+    assert scenarios.off_tuned(scenarios.get("view-desync-storm"),
+                               smoke_cfg) == {}
+    rc = cli.main(argv)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["scenario"]["name"] == "view-desync-storm"
+    assert out["scenario"]["passed"] is True
+    assert out["telemetry"]["view_spread_max"] > 0
+    assert out["telemetry"]["desync_rounds"] > 0
+    assert out["telemetry"]["sync_msgs_delivered"] > 0
+    assert out["telemetry"]["safety_violations"] == 0
 
 
 def test_python_cli_rejects_cpu_scenario():

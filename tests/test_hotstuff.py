@@ -33,6 +33,24 @@ CFGS = [
                         partition_rate=0.05, churn_rate=0.01,
                         crash_prob=0.05, recover_prob=0.3,
                         max_crashed=10, max_delay_rounds=2, seed=7),
+    # SPEC §B view desync composed with drops (drops keep the healed
+    # views apart) — the premature-timeout path, gossip catch-up, and
+    # per-receiver leader identity all live.
+    dataclasses.replace(BASE, desync_rate=0.15, max_skew_rounds=4,
+                        view_timeout=4, drop_rate=0.25, seed=11),
+    # §B + §6c + §7c together: crash recovery resets a node's view to 0
+    # while skew pushes others ahead — maximal view spread.
+    dataclasses.replace(BASE, f=10, n_nodes=31, n_byzantine=7,
+                        byz_mode="equivocate", desync_rate=0.1,
+                        max_skew_rounds=3, view_timeout=4, drop_rate=0.15,
+                        crash_prob=0.05, recover_prob=0.3, max_crashed=3,
+                        seed=13),
+    # Big-N synchronizer parity (N = 1024 <= 2k): leader wrap + gossip
+    # min-id tie-break at scale, desync composed with delivery faults.
+    dataclasses.replace(BASE, f=341, n_nodes=1024, n_rounds=32,
+                        n_sweeps=1, log_capacity=32, desync_rate=0.1,
+                        max_skew_rounds=4, view_timeout=4, drop_rate=0.1,
+                        partition_rate=0.05, seed=17),
 ]
 
 
@@ -157,3 +175,92 @@ def test_hotstuff_flagship_digest_pair():
     cpu = simulator.run(dataclasses.replace(cfg, engine="cpu"),
                         warmup=False)
     assert tpu.payload == cpu.payload, (tpu.digest, cpu.digest)
+
+
+# --- SPEC §B per-node view synchronizer vs the retired global pacemaker -----
+#
+# The synchronizer's sync path must reproduce the retired one-scalar
+# pacemaker — kept verbatim as a test-only reference
+# (tests/reference_hotstuff.py) — wherever views stay in lockstep: zero
+# delivery-fault rates (drops/partitions/crashes are exactly what the
+# per-node model lets desynchronize views), with churn and both
+# byzantine modes composed (those stall every node identically). The
+# mapping: production per-node view[i] == retired GLOBAL gview for all
+# i, every other leaf byte-equal, every counter except view_changes
+# equal (a timeout is now N per-node advances, not one global one).
+
+LOCKSTEP_CONFIGS = [
+    ("faultfree", BASE),
+    ("churn", dataclasses.replace(BASE, churn_rate=0.3, seed=1)),
+    ("byz-silent", dataclasses.replace(BASE, f=10, n_nodes=31,
+                                       n_byzantine=7, seed=5)),
+    ("byz-equiv", dataclasses.replace(BASE, n_byzantine=2,
+                                      byz_mode="equivocate",
+                                      churn_rate=0.1, seed=7)),
+    ("switch-equiv", dataclasses.replace(BASE, n_byzantine=2,
+                                         byz_mode="equivocate",
+                                         net_model="switch",
+                                         n_aggregators=2, seed=9)),
+]
+
+
+@pytest.mark.parametrize("tag,cfg", LOCKSTEP_CONFIGS,
+                         ids=[t for t, _ in LOCKSTEP_CONFIGS])
+def test_synchronizer_bit_identical_to_retired_pacemaker(tag, cfg):
+    import pathlib
+    import sys
+    sys.path.insert(0, str(pathlib.Path(__file__).parent))
+    from reference_hotstuff import reference_engine
+
+    from consensus_tpu.engines import hotstuff
+    from consensus_tpu.network import runner
+
+    new_stats, ref_stats = {}, {}
+    new = runner.run(cfg, hotstuff.get_engine(), stats=new_stats,
+                     telemetry=True)
+    ref = runner.run(cfg, reference_engine(), stats=ref_stats,
+                     telemetry=True)
+    for key in new:
+        if key == "view":
+            want = np.broadcast_to(ref["gview"][..., None],
+                                   new["view"].shape)
+        else:
+            want = ref[key]
+        np.testing.assert_array_equal(new[key], want, err_msg=(tag, key))
+    for name, vals in ref_stats["telemetry"].items():
+        if name == "view_changes":
+            continue
+        np.testing.assert_array_equal(new_stats["telemetry"][name], vals,
+                                      err_msg=(tag, name))
+
+
+def test_desync_skew_fires_premature_timeouts():
+    """SPEC §B STREAM_DESYNC end to end: a zero-rate config is
+    bit-identical to the default program, and a hot desync composed
+    with drop desynchronizes end-of-round views (nonzero spread), fires
+    premature view changes, and drives sync traffic — the counters the
+    view-desync-storm scenario gates on."""
+    stats: dict = {}
+    base = dataclasses.replace(BASE, view_timeout=4)
+    res0 = simulator.run(base, warmup=False)
+    resz = simulator.run(dataclasses.replace(base, desync_rate=0.0),
+                         warmup=False)
+    assert res0.payload == resz.payload
+    hot = dataclasses.replace(base, desync_rate=0.15, max_skew_rounds=4,
+                              drop_rate=0.25)
+    res1 = simulator.run(hot, warmup=False, telemetry=True, stats=stats)
+    assert res1.payload != res0.payload
+    tel = stats["telemetry"]
+    assert int(tel["view_spread_max"].sum()) > 0
+    assert int(tel["desync_rounds"].sum()) > 0
+    assert int(tel["sync_msgs_delivered"].sum()) > 0
+    assert int(tel["view_changes"].sum()) > 0
+
+
+def test_desync_knob_validation():
+    with pytest.raises(ValueError, match="desync_rate"):
+        Config(protocol="raft", f=2, n_nodes=7, desync_rate=0.1)
+    with pytest.raises(ValueError, match="max_skew_rounds"):
+        dataclasses.replace(BASE, desync_rate=0.1, max_skew_rounds=9)
+    with pytest.raises(ValueError, match="max_skew_rounds"):
+        dataclasses.replace(BASE, max_skew_rounds=2)

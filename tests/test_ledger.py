@@ -161,6 +161,27 @@ def test_failed_driver_round_keeps_its_hole_visible(tmp_path):
     assert row["ok"] is False and "no parseable" in row["notes"]
 
 
+def test_trajectoryless_round_carries_explicit_marker(tmp_path):
+    """A BENCH round with a parseable metric but no trajectory block
+    (pre-trajectory capture, or bench.py died before emitting it) is
+    marked `no-trajectory` — distinguishable from a healthy thin row."""
+    (tmp_path / "benchmarks").mkdir()
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "n": 2, "cmd": "python bench.py", "rc": 0,
+        "tail": "raft-100000node-64round-cap8 ...",
+        "parsed": {"metric": "raft-100000node-64round-cap8 "
+                             "node-round-steps/sec [tpu]",
+                   "value": 58.0e6, "unit": "steps/sec"}}))
+    doc = ledger.build(tmp_path)
+    [row] = doc["rows"]
+    assert row["ok"] is True
+    assert "no-trajectory" in row["notes"]
+    # ...and a round WITH the block stays unmarked.
+    from tools.ledger import bench_rows
+    assert all("no-trajectory" not in (r["notes"] or "")
+               for r in bench_rows(REPO, {}) if r["wall_s"] is not None)
+
+
 def test_committed_ledger_is_valid_and_regenerable(tmp_path):
     committed = REPO / "benchmarks" / "LEDGER.json"
     errs = validate_trace.validate_ledger(committed)

@@ -36,6 +36,14 @@ CFGS = [
     # (pbft.py P4/P5 `extra`) are exercised well beyond toy sizes.
     _cfg(f=8, n_byzantine=8, byz_mode="equivocate", drop_rate=0.2,
          churn_rate=0.05, view_timeout=4, n_rounds=48, n_sweeps=2, seed=9),
+    # SPEC §B per-node timer skew: premature view changes fire at round
+    # start (P2's timeout precedes pre-prepare), composed with drops so
+    # the f+1 catch-up rule has real spread to heal.
+    _cfg(f=2, desync_rate=0.2, max_skew_rounds=4, view_timeout=4,
+         drop_rate=0.15, seed=10),
+    _cfg(f=3, n_byzantine=3, byz_mode="equivocate", desync_rate=0.15,
+         max_skew_rounds=3, view_timeout=4, drop_rate=0.2,
+         partition_rate=0.1, n_rounds=96, seed=12),
 ]
 
 

@@ -39,6 +39,14 @@ CONFIGS = [
     ("f3-nopart-hostile", _cfg(f=3, drop_rate=0.2, partition_rate=0.0,
                                churn_rate=0.05, n_byzantine=3,
                                byz_mode="equivocate", n_rounds=64, seed=21)),
+    # SPEC §B view desync under the broadcast-atomic fault model: the
+    # per-(slot, side) aggregate round with genuinely skewed views.
+    ("f2-desync", _cfg(f=2, desync_rate=0.2, max_skew_rounds=4,
+                       view_timeout=4, seed=23)),
+    # Mid-size §B shape (N = 301): wrap-around primaries + catch-up
+    # healing at the population the bcast model exists for.
+    ("f100-desync", _cfg(f=100, n_rounds=24, desync_rate=0.1,
+                         max_skew_rounds=3, view_timeout=4, seed=29)),
 ]
 
 

@@ -154,3 +154,23 @@ def test_liveness_across_fs(sweep, bcast_sweep):
         for k, o in enumerate(out):
             assert o["committed"].any(), \
                 f"{tag} f={FS[k]} committed nothing"
+
+
+def test_padded_desync_equals_unpadded():
+    """SPEC §B timer skew must survive padding byte-identically (its
+    draws are keyed by absolute ids) — under both fault granularities,
+    composed with the delivery faults that keep views desynchronized."""
+    for fault_base in (BASE, BCAST):
+        base = dataclasses.replace(fault_base, desync_rate=0.2,
+                                   max_skew_rounds=4, view_timeout=4)
+        out = pbft_fsweep_run(base, [1, 2])
+        for k, f in enumerate([1, 2]):
+            exact = pbft_run(_rung_cfg(base, f, k))
+            _assert_rung_equal(out[k], exact)
+            # ... and the scalar oracle agrees with the padded rung.
+            oracle = bindings.pbft_run(_rung_cfg(base, f, k))
+            c = oracle["committed"].astype(bool)
+            np.testing.assert_array_equal(out[k]["committed"][0], c)
+            np.testing.assert_array_equal(
+                out[k]["dval"][0][c].astype(np.uint32),
+                oracle["dval"][c].astype(np.uint32))

@@ -216,6 +216,30 @@ def cmd_report(args) -> int:
     return 0
 
 
+DEFAULT_XPROTO = "benchmarks/parts/cross_protocol.json"
+
+
+def cmd_crossproto(args) -> int:
+    """The shared-fault degradation ladder over all six engines
+    (search.cross_protocol_ladder): one compiled program per engine,
+    the drop-rate rungs as knob lanes, artifact committed so
+    docs/RESILIENCE.md §8 can cite which protocol degrades first."""
+    from .search import cross_protocol_ladder
+    doc = cross_protocol_ladder(args.seed, log=_log)
+    out = args.out or str(
+        pathlib.Path(__file__).resolve().parents[2] / DEFAULT_XPROTO)
+    p = pathlib.Path(out)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp.json")
+    tmp.write_text(json.dumps(doc, indent=2, sort_keys=True))
+    tmp.replace(p)
+    _log(f"cross-protocol ladder written to {out}; degrades first: "
+         f"{doc['degrades_first'][0]}")
+    print(json.dumps({"degrades_first": doc["degrades_first"],
+                      "out": out}))
+    return 0
+
+
 # The fixed smoke budget: tiny, seeded, CPU-friendly — the `make
 # advsearch-smoke` gate (tools/check.py) and the tier-1 mirror test
 # reuse these numbers verbatim so the two cannot drift.
@@ -331,6 +355,14 @@ def main(argv=None) -> int:
                    help="catalog JSON path (default: the package's "
                         "consensus_tpu/scenarios/discovered.json)")
 
+    x = sub.add_parser("crossproto",
+                       help="run the shared-fault degradation ladder "
+                            "over all six engines and commit the "
+                            "comparison artifact (RESILIENCE §8)")
+    x.add_argument("--seed", type=int, default=0)
+    x.add_argument("--out", default="",
+                   help=f"artifact path (default {DEFAULT_XPROTO})")
+
     r = sub.add_parser("report",
                        help="write a search state's findings to the "
                             "standalone attack-findings artifact — the "
@@ -385,7 +417,8 @@ def main(argv=None) -> int:
     return {"spaces": cmd_spaces, "search": cmd_search,
             "distill": cmd_distill, "report": cmd_report,
             "smoke": cmd_smoke, "promote": cmd_promote,
-            "budget": cmd_budget}[args.cmd](args)
+            "budget": cmd_budget,
+            "crossproto": cmd_crossproto}[args.cmd](args)
 
 
 if __name__ == "__main__":

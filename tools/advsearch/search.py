@@ -43,6 +43,8 @@ RATE_CUTOFFS = {
     # replicas lying to their switch vertex.
     "agg_poison_rate": "agg_poison_cutoff",
     "byz_uplink_rate": "byz_uplink_cutoff",
+    # SPEC §B per-node view-synchronizer timer skew (pbft/hotstuff).
+    "desync_rate": "desync_cutoff",
 }
 
 # STREAM_SEARCH subdraw selectors (c0); c1 packs (candidate, knob) as
@@ -234,6 +236,44 @@ SPACES: dict[str, Space] = {s.name: s for s in (
         base=Config(protocol="hotstuff", f=2, n_nodes=7,
                     log_capacity=96, view_timeout=4, net_model="switch",
                     n_aggregators=2, agg_byz=1, n_byzantine=2,
+                    byz_mode="equivocate", agg_poison_rate=0.3,
+                    byz_uplink_rate=0.2, drop_rate=0.1, **_ADV),
+        knobs=(KnobRange("agg_poison_rate", 0.05, 0.95),
+               KnobRange("byz_uplink_rate", 0.05, 0.95),
+               KnobRange("drop_rate", 0.0, 0.40))),
+    Space(
+        name="hotstuff-view-desync",
+        description="SPEC §B view desync on chained HotStuff: "
+                    "STREAM_DESYNC timer skew (max_skew_rounds 4 is the "
+                    "static axis) fires premature local view changes "
+                    "while drops keep the highest-QC gossip from healing "
+                    "the spread — hunting the desync/drop/churn "
+                    "composition where per-node views diverge faster "
+                    "than catch-up converges them, at the short "
+                    "pacemaker timeout. The tuned view-desync-storm "
+                    "scenario is one point of this space; the search "
+                    "asks how little skew still starves commits.",
+        base=Config(protocol="hotstuff", f=2, n_nodes=7,
+                    log_capacity=96, view_timeout=4, desync_rate=0.15,
+                    max_skew_rounds=4, drop_rate=0.25, churn_rate=0.02,
+                    **_ADV),
+        knobs=(KnobRange("desync_rate", 0.02, 0.60),
+               KnobRange("drop_rate", 0.05, 0.60),
+               KnobRange("churn_rate", 0.0, 0.15))),
+    Space(
+        name="hotstuff-forked-qc-1k",
+        description="The hotstuff-forked-qc §7c x §9b composition at "
+                    "big N (N = 1024, f = 341, 16 aggregators ⇒ 64-node "
+                    "segments): one poisoned tail aggregator now forges "
+                    "a full 64-vote segment per serve — does the silent "
+                    "QC fork that needs only ~2f+1 = 683 tallied votes "
+                    "get EASIER as segment width grows, or does the "
+                    "honest-majority mass of the other 15 segments "
+                    "drown the forgery? Findings (or the negative) "
+                    "recorded in docs/RESILIENCE.md §8.",
+        base=Config(protocol="hotstuff", f=341, n_nodes=1024,
+                    log_capacity=96, view_timeout=4, net_model="switch",
+                    n_aggregators=16, agg_byz=1, n_byzantine=341,
                     byz_mode="equivocate", agg_poison_rate=0.3,
                     byz_uplink_rate=0.2, drop_rate=0.1, **_ADV),
         knobs=(KnobRange("agg_poison_rate", 0.05, 0.95),
@@ -717,6 +757,81 @@ def _confirm(space: Space, knobs: dict[str, float], seed: int) -> dict:
             **({} if ok else {"oracle_digest": cpu.digest})}
 
 
+# --- cross-protocol degradation ladder --------------------------------------
+#
+# The "which protocol degrades first" satellite (docs/RESILIENCE.md
+# §8): the SAME shared-fault ladder — drop_rate rungs, everything else
+# at a light common baseline — run over ALL six engines at a common
+# small shape (7 nodes, 96 rounds), one compiled program per engine
+# with the rungs as knob lanes. Not a search: a fixed, seeded probe
+# whose artifact records the first rung where each protocol's
+# availability falls through the floor.
+
+_XPROTO = dict(telemetry_window=4, n_rounds=96, seed=0,
+               drop_rate=0.3, churn_rate=0.02)
+XPROTO_BASES: dict[str, Config] = {
+    "raft": Config(protocol="raft", n_nodes=7, log_capacity=128,
+                   max_entries=96, **_XPROTO),
+    "pbft": Config(protocol="pbft", f=2, n_nodes=7, log_capacity=96,
+                   **_XPROTO),
+    "pbft-bcast": Config(protocol="pbft", fault_model="bcast", f=2,
+                         n_nodes=7, log_capacity=96, **_XPROTO),
+    "paxos": Config(protocol="paxos", n_nodes=7, log_capacity=96,
+                    **_XPROTO),
+    "dpos": Config(protocol="dpos", n_nodes=7, n_candidates=6,
+                   n_producers=3, log_capacity=96, **_XPROTO),
+    "hotstuff": Config(protocol="hotstuff", f=2, n_nodes=7,
+                       log_capacity=96, **_XPROTO),
+}
+XPROTO_LADDER = (0.05, 0.15, 0.25, 0.35, 0.45, 0.55, 0.65, 0.75)
+XPROTO_FLOOR = 0.5  # availability at/below this rung = "degraded"
+
+
+def cross_protocol_ladder(search_seed: int, *, ladder=XPROTO_LADDER,
+                          floor: float = XPROTO_FLOOR, log=None) -> dict:
+    """Run the shared drop-rate ladder across every engine; returns the
+    JSON-ready comparison document. Rung r of every protocol sees the
+    same drop_rate and the same per-rung trajectory seed, so the
+    ordering of first-degraded rungs is a protocol property, not a
+    seed artifact."""
+    from consensus_tpu.network import simulator
+
+    log = log or (lambda *_: None)
+    seeds = np.array([eval_seed(search_seed, 0, r)
+                      for r in range(len(ladder))], np.uint32)
+    protocols: dict[str, dict] = {}
+    for name, base in sorted(XPROTO_BASES.items()):
+        cfg = dataclasses.replace(base, n_sweeps=len(ladder))
+        kmat = np.array(
+            [[int(getattr(dataclasses.replace(base, drop_rate=rate), col))
+              for col in KNOB_COLUMNS] for rate in ladder], np.uint32)
+        eng = simulator.engine_def(cfg)
+        out, flight = _dispatch(cfg, eng, seeds, kmat, generation=0)
+        from consensus_tpu.obs import timeline as obs_timeline
+        mets = obs_timeline.lane_fitness(
+            obs_timeline.from_flight_dict(flight))
+        avail = [m["availability"] for m in mets]
+        first = next((r for r, a in enumerate(avail) if a <= floor), None)
+        protocols[name] = {
+            "availability": avail,
+            "never_recovered": [m["never_recovered"] for m in mets],
+            "first_degraded_rung": first,
+            "first_degraded_rate": None if first is None
+            else ladder[first],
+        }
+        log(f"{name}: availability {avail} "
+            f"(first <= {floor} at rung {first})")
+    order = sorted(protocols,
+                   key=lambda n: (protocols[n]["first_degraded_rung"]
+                                  if protocols[n]["first_degraded_rung"]
+                                  is not None else len(ladder)))
+    return {"version": 1, "search_seed": search_seed,
+            "ladder": list(ladder), "floor": floor,
+            "shape": {"n_nodes": 7, "n_rounds": 96,
+                      "churn_rate": _XPROTO["churn_rate"]},
+            "protocols": protocols, "degrades_first": order}
+
+
 # --- §A.3 attack-space reports ----------------------------------------------
 #
 # Findings from UNMIRRORED spaces (the SPEC §A.3 targeted attacks are
@@ -786,6 +901,11 @@ def _bounds_from_metrics(m: dict[str, Any]) -> dict[str, Any]:
         "max_availability": round(min(0.99, avail + 0.4), 3),
         "min_availability": round(max(0.02, avail - 0.3), 3),
     }
+    if m["never_recovered"] and avail <= 0.02:
+        # A total-collapse finding: the claim IS "commits die and stay
+        # dead" — a liveness floor would contradict it on any fresh
+        # seed that reproduces the collapse.
+        del b["min_availability"]
     if m["stall_windows"] > 0:
         b["min_stall_windows"] = max(1, m["stall_windows"] // 3)
     if not m["never_recovered"] and m["recovery_rounds"] is not None:

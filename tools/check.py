@@ -148,6 +148,16 @@ SWITCH_SMOKE = ["-m", "consensus_tpu", "--scenario",
                 "--f", "2", "--rounds", "96", "--log-capacity", "96",
                 "--sweeps", "2", "--seed", "11", "--platform", "cpu"]
 
+# The SPEC §B view-desync smoke: per-node synchronizer timer skew under
+# heavy drops — premature local view changes spread the views faster
+# than the highest-QC gossip heals them, commits stutter, and the
+# synchronizer telemetry (view_spread_max/desync_rounds) is asserted
+# live via the scenario's min_counters.
+DESYNC_SMOKE = ["-m", "consensus_tpu", "--scenario", "view-desync-storm",
+                "--protocol", "hotstuff", "--f", "2", "--rounds", "96",
+                "--log-capacity", "96", "--sweeps", "2", "--seed", "11",
+                "--platform", "cpu"]
+
 
 # tuned-shape Config field -> CLI flag, for building promoted-scenario
 # smokes out of the discovered catalog (same flag names _FLAG_FIELDS in
@@ -193,7 +203,7 @@ def layer_scenarios(_: argparse.Namespace) -> str:
         return "SKIP (jax not installed)"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     for smoke in (SCENARIO_SMOKE, HOTSTUFF_SMOKE, SWITCH_SMOKE,
-                  *promoted_scenario_smokes()):
+                  DESYNC_SMOKE, *promoted_scenario_smokes()):
         if _run([sys.executable] + smoke, env=env):
             return "FAIL"
     return "ok"

@@ -215,6 +215,12 @@ def bench_rows(repo: pathlib.Path, cards: dict[str, dict]) -> list[dict]:
         elif not parsed:
             notes.append("no parseable benchmark line (rc="
                          f"{doc.get('rc')})")
+        if not traj:
+            # Pre-trajectory captures (and rounds whose bench.py died
+            # before emitting the block) have no config echo / wall /
+            # steps — mark the hole explicitly instead of leaving the
+            # row indistinguishable from a thin-but-healthy one.
+            notes.append("no-trajectory")
         out.append(_row(
             source=path.name, kind="driver-bench", name=name,
             seq=doc.get("n"), timestamp=traj.get("timestamp"),

@@ -49,6 +49,7 @@ CHECK = "registry"
 ENGINES_GLOB = "consensus_tpu/engines/*.py"
 ADVERSARY = "consensus_tpu/ops/adversary.py"
 AGGREGATE = "consensus_tpu/ops/aggregate.py"
+VIEWSYNC = "consensus_tpu/ops/viewsync.py"
 VALIDATOR = "tools/validate_trace.py"
 SPLIT_KINDS = {"persistent", "volatile", "meta"}
 FREEZE_FNS = {"freeze_down", "_freeze"}
@@ -95,7 +96,7 @@ def _names_violations(repo: Repo, *, suffix: str, var: str, kind: str,
                           f"no {var} registry found")]
     registry, reg_line = got
     env: dict[str, tuple] = {}
-    for shared in (ADVERSARY, AGGREGATE):
+    for shared in (ADVERSARY, AGGREGATE, VIEWSYNC):
         if repo.exists(shared):
             env.update(_module_str_tuples(repo.tree(shared), {}))
     engine_names: set[str] = set()

@@ -56,6 +56,8 @@ TELEMETRY_COUNTERS = frozenset({
     "poisoned_serves",
     # vote-certificate safety invariants (SPEC §7c, BFT engines)
     "forked_qc", "conflict_commits", "safety_violations",
+    # per-node view synchronizer (SPEC §B, pbft/hotstuff)
+    "view_spread_max", "desync_rounds", "sync_msgs_delivered",
 })
 
 # Every flight-recorder protocol-latency histogram any engine may record
